@@ -1,0 +1,42 @@
+"""TensorDiffEq-TPU: a TPU-native (JAX/XLA) physics-informed neural network
+framework with the capabilities of TensorDiffEq (reference:
+``tensordiffeq/__init__.py:3-24`` namespace parity).
+
+Quick start (Burgers)::
+
+    import numpy as np
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import DomainND, IC, dirichletBC, CollocationSolverND, grad
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(10_000, seed=0)
+
+    init = IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])
+    bcs = [init,
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        u_xx = grad(u_x, "x")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
+
+    solver = CollocationSolverND()
+    solver.compile([2, 20, 20, 20, 20, 1], f_model, domain, bcs)
+    solver.fit(tf_iter=10_000, newton_iter=10_000)
+"""
+
+from . import boundaries, domains, helpers, networks, ops, output  # noqa: F401
+from . import parallel, plotting, sampling, training, utils  # noqa: F401
+from . import models  # noqa: F401
+from .boundaries import (  # noqa: F401
+    BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
+from .domains import DomainND  # noqa: F401
+from .helpers import find_L2_error  # noqa: F401
+from .models import CollocationSolverND  # noqa: F401
+from .networks import MLP, neural_net  # noqa: F401
+from .ops import MSE, UFn, d, g_MSE, grad, laplacian  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Accuracy metrics (parity: reference ``tensordiffeq/helpers.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def find_L2_error(u_pred, u_star) -> float:
+    """Relative L2 error ``||u*-u_pred||/||u*||`` — the accuracy metric used
+    by every reference example (``helpers.py:3-4``)."""
+    u_pred = np.asarray(u_pred).ravel()
+    u_star = np.asarray(u_star).ravel()
+    return float(np.linalg.norm(u_star - u_pred, 2) / np.linalg.norm(u_star, 2))

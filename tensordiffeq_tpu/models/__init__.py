@@ -1,0 +1,5 @@
+"""Solver APIs: forward collocation and inverse discovery models."""
+
+from .collocation import CollocationSolverND  # noqa: F401
+
+__all__ = ["CollocationSolverND"]

@@ -1,0 +1,60 @@
+"""Neural networks for PINN solvers.
+
+TPU-native equivalent of the reference's Keras builder
+(``tensordiffeq/networks.py:10-20``): a fully-connected tanh MLP with
+glorot-normal kernels and a linear head, as a Flax module.
+
+TPU notes: the whole pointwise MLP fuses into a handful of MXU matmuls under
+jit; ``precision``/``param_dtype`` are exposed so the forward pass can run
+bfloat16 on the MXU while PINN loss accumulation stays float32 (second-order
+derivatives through tanh are precision-sensitive — HIGHEST is the accuracy
+default, matching the reference's float32 behaviour).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """``layer_sizes = [n_in, h1, ..., hk, n_out]`` tanh MLP.
+
+    Matches the reference network family: Dense(tanh, glorot_normal) hidden
+    layers, linear glorot-normal output (``networks.py:12-19``).
+    """
+
+    layer_sizes: Sequence[int]
+    activation: Callable = nn.tanh
+    precision: Optional[jax.lax.Precision] = jax.lax.Precision.HIGHEST
+    param_dtype: Any = jnp.float32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel_init = nn.initializers.glorot_normal()
+        for width in self.layer_sizes[1:-1]:
+            x = nn.Dense(width, kernel_init=kernel_init,
+                         precision=self.precision,
+                         param_dtype=self.param_dtype, dtype=self.dtype)(x)
+            x = self.activation(x)
+        x = nn.Dense(self.layer_sizes[-1], kernel_init=kernel_init,
+                     precision=self.precision,
+                     param_dtype=self.param_dtype, dtype=self.dtype)(x)
+        return x
+
+
+def neural_net(layer_sizes: Sequence[int], activation: Callable = nn.tanh,
+               precision: Optional[jax.lax.Precision] = jax.lax.Precision.HIGHEST,
+               dtype: Any = jnp.float32) -> MLP:
+    """Build the standard PINN MLP (parity: reference ``networks.py:10``)."""
+    return MLP(layer_sizes=tuple(layer_sizes), activation=activation,
+               precision=precision, dtype=dtype)
+
+
+def init_params(model: nn.Module, n_in: int, key: jax.Array):
+    """Initialise parameters for a pointwise network taking ``n_in`` coords."""
+    return model.init(key, jnp.zeros((1, n_in), dtype=jnp.float32))

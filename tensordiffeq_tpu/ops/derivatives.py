@@ -1,0 +1,137 @@
+"""The residual-authoring engine — the JAX-idiomatic heart of the framework.
+
+The reference's user contract is a PDE residual written against a *batched*
+network with ``tf.gradients`` over input columns (``examples/burgers-new.py:26-32``,
+consumed at ``models.py:187``).  The TPU-native contract replaces this with a
+**scalar point function**: the user writes the residual at a single point
+``(x, t, ...)`` using ``jax.grad``-based combinators, and the framework vmaps
+it over collocation points and jits the whole thing.  Per-point closed-form
+gradients + ``vmap`` is exactly the shape XLA fuses best on TPU: one traced
+point program → one batched kernel on the MXU, no dynamic shapes.
+
+User-facing example (Burgers)::
+
+    from tensordiffeq_tpu import grad
+
+    def f_model(u, x, t):
+        u_x  = grad(u, "x")
+        u_xx = grad(u_x, "x")
+        u_t  = grad(u, "t")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - nu * u_xx(x, t)
+
+``u`` is a :class:`UFn`: a callable ``u(*coords) -> scalar`` carrying its
+coordinate names, so derivatives can be requested by name or index.  Vector
+outputs are accessed by component: ``u[0]``, ``u[1]`` are scalar ``UFn``s
+(covers the reference's multi-output residual tuple case, ``models.py:189-191``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class UFn:
+    """A scalar (or vector) point function with named coordinates.
+
+    Wraps ``fn(*coords) -> scalar | vector`` and records ``varnames`` so that
+    :func:`grad` can resolve derivative directions by name.
+    """
+
+    def __init__(self, fn: Callable, varnames: Sequence[str],
+                 n_out: int = 1):
+        self._fn = fn
+        self.varnames = tuple(varnames)
+        self.n_out = n_out
+
+    def __call__(self, *coords):
+        return self._fn(*coords)
+
+    def __getitem__(self, k: int) -> "UFn":
+        """Scalar component ``u[k]`` of a vector-valued point function."""
+        if self.n_out == 1:
+            if k != 0:
+                raise IndexError("scalar UFn only has component 0")
+            return self
+        return UFn(lambda *c: self._fn(*c)[k], self.varnames, n_out=1)
+
+    def argnum(self, var: Union[str, int]) -> int:
+        if isinstance(var, int):
+            return var
+        try:
+            return self.varnames.index(var)
+        except ValueError:
+            raise ValueError(
+                f"Unknown variable {var!r}; this function has coordinates "
+                f"{self.varnames}") from None
+
+
+def grad(u: Union[UFn, Callable], var: Union[str, int] = 0) -> UFn:
+    """Derivative of a scalar point function along coordinate ``var``.
+
+    ``var`` may be a coordinate name (``"x"``) when ``u`` is a :class:`UFn`,
+    or a positional index.  Nested freely for higher orders:
+    ``grad(grad(u, "x"), "x")`` is ``u_xx``.
+    """
+    if isinstance(u, UFn):
+        if u.n_out != 1:
+            raise ValueError(
+                "grad() needs a scalar function; select a component first, "
+                "e.g. grad(u[0], 'x')")
+        num = u.argnum(var)
+        return UFn(jax.grad(u._fn, argnums=num), u.varnames, n_out=1)
+    if not isinstance(var, int):
+        raise ValueError("grad(fn, 'name') requires a UFn; pass an int argnum")
+    return UFn(jax.grad(u, argnums=var), varnames=(), n_out=1)
+
+
+def d(u: UFn, var: Union[str, int], order: int = 1) -> UFn:
+    """``order``-th derivative along one coordinate: ``d(u, 'x', 2)`` = u_xx."""
+    out = u
+    for _ in range(order):
+        out = grad(out, var)
+    return out
+
+
+def laplacian(u: UFn, spatial_vars: Optional[Sequence[Union[str, int]]] = None) -> UFn:
+    """Sum of unmixed second derivatives over ``spatial_vars`` (default: all
+    coordinates).  Common enough in the reference examples (Helmholtz/Poisson
+    steady state, ``examples/steady-state.py``) to deserve a combinator."""
+    names = spatial_vars if spatial_vars is not None else range(len(u.varnames))
+    terms = [d(u, v, 2) for v in names]
+    return UFn(lambda *c: sum(t(*c) for t in terms), u.varnames, n_out=1)
+
+
+def make_ufn(apply_fn: Callable, params, varnames: Sequence[str],
+             n_out: int = 1) -> UFn:
+    """Bind a Flax-style ``apply_fn(params, x[d]) -> y[n_out]`` into a
+    per-point :class:`UFn` over scalar coordinates.
+
+    This is the bridge the solver uses: the batched network becomes a scalar
+    point function, derivatives are exact per-point ``jax.grad`` chains, and
+    the whole residual is later ``vmap``-ed back over the point batch (the
+    TPU-native replacement for ``tf.gradients`` on column tensors,
+    reference ``models.py:63,187``).
+    """
+    def u_point(*coords):
+        x = jnp.stack([jnp.asarray(c, dtype=jnp.float32) for c in coords])
+        out = apply_fn(params, x)
+        return out[0] if n_out == 1 else out
+
+    return UFn(u_point, varnames, n_out=n_out)
+
+
+def vmap_residual(f_model: Callable, u: UFn, n_coords: int) -> Callable:
+    """Turn a per-point residual ``f_model(u, *coords)`` into a batched
+    function over an ``[N, n_coords]`` point matrix.
+
+    Returns ``residual(X) -> [N] | tuple of [N]`` (tuples for multi-equation
+    systems, mirroring reference ``models.py:189-191``).
+    """
+    def per_point(pt):
+        coords = tuple(pt[i] for i in range(n_coords))
+        return f_model(u, *coords)
+
+    return jax.vmap(per_point)

@@ -1,0 +1,59 @@
+"""Loss primitives for collocation training.
+
+TPU-native re-design of the reference's weighted-MSE family
+(``tensordiffeq/utils.py:38-48``).  All functions are pure, jit-safe and
+dtype-preserving; they operate on arrays of any shape and reduce with a full
+mean, exactly matching the reference semantics:
+
+* ``MSE(pred, actual)``                     -> ``mean((pred-actual)**2)``
+* ``MSE(..., weights, outside_sum=False)``  -> ``mean((w*(pred-actual))**2)``
+  (the SA-PINN "type 1" per-point weighting, McClenny et al. arXiv:2009.04544)
+* ``MSE(..., weights, outside_sum=True)``   -> ``w * mean((pred-actual)**2)``
+  ("type 2" scalar per-loss weighting)
+* ``g_MSE(pred, actual, g_lam)``            -> ``mean(g_lam*(pred-actual)**2)``
+
+For distributed training the mean is computed locally per shard; under
+``jax.jit`` over a :class:`jax.sharding.Mesh` XLA inserts the cross-device
+reduction automatically, so these stay backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+def MSE(pred, actual=0.0, weights: Optional[jnp.ndarray] = None,
+        outside_sum: bool = False):
+    """Weighted mean-squared error (reference: ``utils.py:38-44``)."""
+    diff = pred - actual
+    if weights is not None:
+        if outside_sum:
+            return weights * jnp.mean(jnp.square(diff))
+        return jnp.mean(jnp.square(weights * diff))
+    return jnp.mean(jnp.square(diff))
+
+
+def g_MSE(pred, actual, g_lam):
+    """MSE with a multiplicative weight *inside* the mean but *outside* the
+    square (reference: ``utils.py:47-48``): ``mean(g_lam * (pred-actual)**2)``.
+    Used for the optional ``g(lambda)`` transform of SA weights."""
+    return jnp.mean(g_lam * jnp.square(pred - actual))
+
+
+def default_g(lam):
+    """Default SA-weight transform ``g(lam) = lam**2`` (the convention used by
+    the reference's older API, ``examples/AC-dist.py:89-90``)."""
+    return jnp.square(lam)
+
+
+def relative_l2(pred, ref):
+    """Relative L2 error ``||ref - pred||_2 / ||ref||_2`` — THE accuracy
+    metric of every reference example (``helpers.py:3-4``)."""
+    pred = jnp.ravel(pred)
+    ref = jnp.ravel(ref)
+    return jnp.linalg.norm(ref - pred) / jnp.linalg.norm(ref)
+
+
+LossFn = Callable[..., jnp.ndarray]

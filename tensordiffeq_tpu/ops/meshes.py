@@ -1,0 +1,35 @@
+"""Host-side mesh construction helpers.
+
+These mirror the behavioural contract of the reference's ``multimesh`` /
+``flatten_and_stack`` pair (``tensordiffeq/utils.py:72-99``): build an
+N-dimensional tensor-product grid from per-axis 1-D arrays and flatten it to a
+``[n_points, n_dims]`` design matrix suitable for a pointwise network.
+
+This is problem *assembly*, not the hot path: it runs once on host in NumPy
+(float64 for accuracy), and its products are moved to device as constants when
+the solver jits the loss.  Keeping it NumPy avoids polluting jit traces with
+setup work, exactly the split the XLA compilation model wants.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def multimesh(arrs: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """N-D tensor-product grid of 1-D arrays, ``np.meshgrid(indexing='ij')``
+    semantics (behaviour parity with reference ``utils.py:72-93``)."""
+    return list(np.meshgrid(*[np.asarray(a) for a in arrs], indexing="ij"))
+
+
+def flatten_and_stack(mesh: Sequence[np.ndarray]) -> np.ndarray:
+    """Flatten each grid of ``multimesh`` output and stack columns into an
+    ``[n_points, n_dims]`` matrix (reference ``utils.py:96-99``)."""
+    return np.stack([np.asarray(m).ravel() for m in mesh], axis=-1)
+
+
+def grid_points(arrs: Sequence[np.ndarray]) -> np.ndarray:
+    """Convenience: ``flatten_and_stack(multimesh(arrs))``."""
+    return flatten_and_stack(multimesh(arrs))

@@ -1,0 +1,26 @@
+"""Console banner & model summary at training start
+(parity: reference ``tensordiffeq/output.py:5-11``, minus the pyfiglet
+dependency — a static banner avoids an extra package)."""
+
+from __future__ import annotations
+
+import jax
+
+_BANNER = r"""
+ _____                       ___  _  __  __ ___       _____ ___ _   _
+|_   _|__ _ _  ___ ___ _ _ |   \(_)/ _|/ _| __|__ _ |_   _| _ \ | | |
+  | |/ -_) ' \(_-</ _ \ '_|| |) | |  _|  _| _|/ _` |  | | |  _/ |_| |
+  |_|\___|_||_/__/\___/_|  |___/|_|_| |_| |___\__, |  |_| |_|  \___/
+                                                 |_|
+"""
+
+
+def print_screen(solver, discovery_model: bool = False):
+    """Print the banner, device inventory and parameter count."""
+    print(_BANNER)
+    devices = jax.devices()
+    print(f"Backend: {devices[0].platform} | devices: {len(devices)}")
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(solver.params))
+    kind = "DiscoveryModel" if discovery_model else type(solver).__name__
+    print(f"{kind}: layer_sizes={getattr(solver, 'layer_sizes', '?')} "
+          f"({n_params:,} parameters)")

@@ -1,0 +1,4 @@
+"""Training engines: jitted Adam(+SA) scan loops and on-device L-BFGS."""
+
+from .fit import FitResult, fit_adam, make_optimizer  # noqa: F401
+from .lbfgs import fit_lbfgs, lbfgs_minimize  # noqa: F401

@@ -1,0 +1,186 @@
+"""Training engine: jit-compiled Adam (+SA minimax) epoch loops.
+
+TPU-native replacement for the reference's eager epoch loop
+(``fit.py:17-102``): instead of one Python-dispatched ``tf.function`` call
+per epoch, whole *chunks* of epochs run inside a single ``lax.scan`` under
+one ``jax.jit`` — the device never waits on the host between steps, and on a
+sharded collocation batch XLA turns the loss means into ICI all-reduces
+automatically (the design replacing ``MirroredStrategy``/``strategy.reduce``,
+reference ``models.py:235``, ``fit.py:183-187``).
+
+Self-adaptive λ ascent is a single ``optax.multi_transform``: network params
+get Adam; λ get ``scale(-1) → Adam`` — gradient *ascent*, the SA-PINN minimax
+of reference ``fit.py:135-141`` without its dual-optimizer bookkeeping.
+
+Minibatching scans over pre-reshaped ``[n_batches, bsz, d]`` shards and runs
+**every** batch each epoch (the reference's loop returns after batch 0 —
+SURVEY §2.4.1), and composes with SA weights by gathering λ rows alongside
+their points (lifting the reference restriction at ``models.py:228-229``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..utils import tree_copy
+from .progress import progress_bar
+
+
+def make_optimizer(lr: float = 0.005, lr_weights: float = 0.005,
+                   b1: float = 0.99) -> optax.GradientTransformation:
+    """Adam for the network + Adam-ascent for λ (reference defaults
+    ``lr=0.005, beta_1=0.99``, ``models.py:49-50``), as one transform."""
+
+    def label_fn(trainables):
+        return {
+            "params": jax.tree_util.tree_map(lambda _: "net", trainables["params"]),
+            "lambdas": jax.tree_util.tree_map(lambda _: "lam", trainables["lambdas"]),
+        }
+
+    return optax.multi_transform(
+        {"net": optax.adam(lr, b1=b1),
+         "lam": optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=b1))},
+        label_fn)
+
+
+@dataclass
+class FitResult:
+    """Host-side training record (parity with the reference's ``self.losses``
+    history and best-model tracking, ``models.py:17-25,117``)."""
+    losses: list = field(default_factory=list)
+    min_loss: dict = field(default_factory=lambda: {"adam": np.inf,
+                                                    "l-bfgs": np.inf,
+                                                    "overall": np.inf})
+    best_epoch: dict = field(default_factory=lambda: {"adam": -1,
+                                                      "l-bfgs": -1,
+                                                      "overall": -1})
+    best_params: dict = field(default_factory=lambda: {"adam": None,
+                                                       "l-bfgs": None,
+                                                       "overall": None})
+    wall_time: dict = field(default_factory=dict)
+
+
+def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
+                  n_batches: int):
+    """Build the jitted multi-step runner.
+
+    Returns ``run(trainables, opt_state, best, X_batched, idx_batched,
+    step0, n_steps) -> (trainables, opt_state, best, components)`` executing
+    ``n_steps`` optimizer steps in one on-device ``lax.scan``.
+
+    ``best`` carries ``(params_snapshot, best_loss, best_step)`` and is
+    updated with a pytree select each step — a true copy, fixing the
+    reference's aliasing best-model bug (SURVEY §2.4.6).
+    """
+
+    def loss_over_trainables(trainables, X_b, idx_b):
+        lambdas = trainables["lambdas"]
+        if n_batches == 1:
+            lam_res = lambdas["residual"]
+        else:
+            lam_res = [None if lam is None else lam[idx_b]
+                       for lam in lambdas["residual"]]
+        return loss_fn(trainables["params"], lambdas["BCs"], lam_res, X_b)
+
+    grad_fn = jax.value_and_grad(loss_over_trainables, has_aux=True)
+
+    @partial(jax.jit, static_argnames=("n_steps",))
+    def run(trainables, opt_state, best, X_batched, idx_batched, step0,
+            n_steps: int):
+        def step(carry, i):
+            trainables, opt_state, best = carry
+            b = i % n_batches
+            X_b = X_batched[b] if n_batches > 1 else X_batched[0]
+            idx_b = idx_batched[b] if n_batches > 1 else idx_batched[0]
+            (total, comps), grads = grad_fn(trainables, X_b, idx_b)
+            updates, opt_state = opt.update(grads, opt_state, trainables)
+            trainables = optax.apply_updates(trainables, updates)
+
+            best_params, best_loss, best_step = best
+            improved = total < best_loss
+            best = (
+                jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(improved, new, old),
+                    trainables["params"], best_params),
+                jnp.where(improved, total, best_loss),
+                jnp.where(improved, step0 + i, best_step),
+            )
+            return (trainables, opt_state, best), comps
+
+        (trainables, opt_state, best), comps = jax.lax.scan(
+            step, (trainables, opt_state, best), jnp.arange(n_steps))
+        return trainables, opt_state, best, comps
+
+    return run
+
+
+def fit_adam(loss_fn: Callable,
+             params,
+             lambdas,
+             X_f: jnp.ndarray,
+             tf_iter: int,
+             batch_sz: Optional[int] = None,
+             lr: float = 0.005,
+             lr_weights: float = 0.005,
+             chunk: int = 100,
+             verbose: bool = True,
+             result: Optional[FitResult] = None,
+             ) -> tuple[Any, Any, FitResult]:
+    """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
+    ``trainables = {"params":…, "lambdas":…}`` at the final step and the
+    training record (losses per epoch, best snapshot)."""
+    result = result or FitResult()
+    N_f = X_f.shape[0]
+    if batch_sz is None or batch_sz >= N_f:
+        n_batches, bsz = 1, N_f
+    else:
+        n_batches = N_f // batch_sz
+        bsz = batch_sz
+        if verbose and n_batches * bsz != N_f:
+            print(f"[fit] dropping {N_f - n_batches * bsz} points so that "
+                  f"{bsz}-point batches tile the collocation set")
+    X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
+    idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
+
+    opt = make_optimizer(lr, lr_weights)
+    trainables = {"params": params, "lambdas": lambdas}
+    opt_state = opt.init(trainables)
+    run = _chunk_runner(loss_fn, opt, n_batches)
+
+    best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
+    total_steps = tf_iter * n_batches
+    t0 = time.time()
+    steps_done = 0
+    pbar = progress_bar(tf_iter, desc="Adam") if verbose else None
+    while steps_done < total_steps:
+        n = int(min(chunk * n_batches, total_steps - steps_done))
+        trainables, opt_state, best, comps = run(
+            trainables, opt_state, best, X_batched, idx_batched,
+            jnp.asarray(steps_done), n)
+        comps = jax.tree_util.tree_map(np.asarray, comps)
+        # record one entry per epoch (last batch of each epoch)
+        for e in range(n // n_batches):
+            i = (e + 1) * n_batches - 1
+            result.losses.append({k: float(v[i]) for k, v in comps.items()})
+        steps_done += n
+        if pbar is not None:
+            pbar.update(n // n_batches)
+            pbar.set_postfix(loss=result.losses[-1]["Total Loss"])
+    if pbar is not None:
+        pbar.close()
+    jax.block_until_ready(trainables)
+    result.wall_time["adam"] = time.time() - t0
+
+    best_params, best_loss, best_step = best
+    result.best_params["adam"] = tree_copy(best_params)
+    result.min_loss["adam"] = float(best_loss)
+    result.best_epoch["adam"] = int(best_step) // max(n_batches, 1)
+    return trainables, opt_state, result

@@ -1,0 +1,134 @@
+"""On-device L-BFGS refinement.
+
+The reference does second-order refinement two ways: a hand-written eager
+L-BFGS with two-loop recursion driven from Python (``optimizers.py:107-313``,
+the default, ``fit.py:60-89``) and a tfp graph-mode variant
+(``optimizers.py:11-104``).  Both pay a host round-trip per iteration.
+
+Here the entire optimization — two-loop recursion (via optax's compact-form
+``scale_by_lbfgs``), zoom line search satisfying strong Wolfe conditions, and
+the iteration loop itself — runs inside ONE jitted ``lax.scan`` chunk on
+device.  The host only sees loss telemetry every ``chunk`` iterations and
+applies the reference's NaN/convergence stops between chunks
+(``optimizers.py:273,290-291`` — including fixing the reference's broken
+``tf.abs(f, f_old)`` convergence test, SURVEY §2.4.5).
+
+L-BFGS optimizes the network parameters only; SA λ stay frozen — matching the
+reference, whose flat-gradient closure covers ``u_model.trainable_variables``
+alone (``models.py:283-295``).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..utils import tree_copy
+from .progress import progress_bar
+
+
+def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
+                   memory_size: int = 50, tol_fun: float = 1e-12,
+                   tol_grad: float = 1e-12, chunk: int = 100,
+                   verbose: bool = False):
+    """Minimise ``fun(pytree) -> scalar`` with jitted L-BFGS.
+
+    Returns ``(x_final, x_best, f_best, best_iter, history)`` where
+    ``history`` is the per-iteration loss as a Python list.  Defaults mirror
+    the reference's eager L-BFGS (50 correction pairs, ``tolFun=1e-12``,
+    ``optimizers.py:114-116``) with a strong-Wolfe zoom line search in place
+    of its fixed 0.8 learning rate.
+    """
+    opt = optax.lbfgs(
+        memory_size=memory_size,
+        linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
+    value_and_grad = optax.value_and_grad_from_state(fun)
+
+    @partial(jax.jit, static_argnames=("n_steps",))
+    def run_chunk(x, state, best, it0, n_steps: int):
+        def step(carry, i):
+            x, state, best = carry
+            value, grad = value_and_grad(x, state=state)
+            updates, state = opt.update(grad, state, x, value=value,
+                                        grad=grad, value_fn=fun)
+            x = optax.apply_updates(x, updates)
+            new_value = optax.tree.get(state, "value")
+
+            x_best, f_best, i_best = best
+            # guard: never adopt a NaN/inf iterate as "best"
+            improved = jnp.isfinite(new_value) & (new_value < f_best)
+            best = (
+                jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(improved, new, old), x, x_best),
+                jnp.where(improved, new_value, f_best),
+                jnp.where(improved, it0 + i, i_best),
+            )
+            gnorm = optax.tree.norm(grad)
+            return (x, state, best), (new_value, gnorm)
+
+        (x, state, best), (values, gnorms) = jax.lax.scan(
+            step, (x, state, best), jnp.arange(n_steps))
+        return x, state, best, values, gnorms
+
+    state = opt.init(x0)
+    x = x0
+    best = (tree_copy(x0), jnp.asarray(jnp.inf), jnp.asarray(-1))
+    history: list[float] = []
+    f_prev = np.inf
+    done = 0
+    pbar = progress_bar(maxiter, desc="L-BFGS") if verbose else None
+    while done < maxiter:
+        n = int(min(chunk, maxiter - done))
+        x, state, best, values, gnorms = run_chunk(
+            x, state, best, jnp.asarray(done), n)
+        values = np.asarray(values)
+        gnorms = np.asarray(gnorms)
+        history.extend(float(v) for v in values)
+        done += n
+        if pbar is not None:
+            pbar.update(n)
+            pbar.set_postfix(loss=float(values[-1]))
+        f_now = float(values[-1])
+        if not np.isfinite(f_now):  # NaN stop (reference optimizers.py:290-291)
+            if verbose:
+                print("[l-bfgs] non-finite loss — stopping, keeping best iterate")
+            break
+        if abs(f_prev - f_now) < tol_fun or float(gnorms[-1]) < tol_grad:
+            break
+        f_prev = f_now
+    if pbar is not None:
+        pbar.close()
+
+    x_best, f_best, i_best = best
+    return x, x_best, f_best, i_best, history
+
+
+def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
+              maxiter: int = 1000, memory_size: int = 50,
+              verbose: bool = True, chunk: int = 100):
+    """L-BFGS phase over network params with SA λ frozen
+    (reference ``fit.py:60-89``).
+
+    Returns ``(params_final, params_best, best_loss, best_iter, loss_dicts)``
+    with ``loss_dicts`` shaped like the Adam history entries."""
+    lam_bcs = lambdas["BCs"]
+    lam_res = lambdas["residual"]
+
+    def fun(p):
+        return loss_fn(p, lam_bcs, lam_res, X_f)[0]
+
+    t0 = time.time()
+    x, x_best, f_best, i_best, history = lbfgs_minimize(
+        fun, params, maxiter=maxiter, memory_size=memory_size,
+        chunk=chunk, verbose=verbose)
+    if verbose:
+        print(f"[l-bfgs] {len(history)} iters in {time.time() - t0:.1f}s, "
+              f"best loss {float(f_best):.3e} @ iter {int(i_best)}")
+    loss_dicts = [{"Total Loss": v} for v in history]
+    return x, tree_copy(x_best), f_best, i_best, loss_dicts

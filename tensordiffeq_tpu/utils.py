@@ -1,0 +1,103 @@
+"""Shared utilities.
+
+Parity layer for the reference's grab-bag ``tensordiffeq/utils.py``, minus
+what JAX makes native:
+
+* flat-vector param packing (``get_weights``/``set_weights``/``get_sizes``,
+  reference ``utils.py:7-35``) → :func:`jax.flatten_util.ravel_pytree`;
+* ``tf.constant``/``convertTensor``/``tensor`` casts → thin jnp aliases;
+* SA-weight initialisation (``initialize_weights_loss``, ``utils.py:102-115``)
+  → :func:`initialize_lambdas`, which builds the λ *pytree* consumed by the
+  solver (a dict of per-term vectors / ``None``), not a flat list + index map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from .ops.losses import MSE, g_MSE  # re-export for parity  # noqa: F401
+from .sampling import LatinHypercubeSample  # noqa: F401
+
+
+def constant(val, dtype=jnp.float32):
+    """Parity: reference ``utils.py:51-52``."""
+    return jnp.asarray(val, dtype=dtype)
+
+
+def convertTensor(val, dtype=jnp.float32):
+    """Parity: reference ``utils.py:55-56``."""
+    return jnp.asarray(val, dtype=dtype)
+
+
+def tensor(x, dtype=jnp.float32):
+    """Parity: reference ``utils.py:68-69``."""
+    return jnp.asarray(x, dtype=dtype)
+
+
+def get_weights(params) -> jnp.ndarray:
+    """Flatten a parameter pytree to one vector (reference ``utils.py:20-29``;
+    here a one-liner thanks to ``ravel_pytree``)."""
+    flat, _ = ravel_pytree(params)
+    return flat
+
+
+def set_weights(params_template, flat: jnp.ndarray):
+    """Rebuild a parameter pytree from a flat vector using the template's
+    structure (reference ``utils.py:7-17``)."""
+    _, unravel = ravel_pytree(params_template)
+    return unravel(flat)
+
+
+def get_sizes(layer_sizes):
+    """Per-layer weight/bias sizes (reference ``utils.py:32-35``); retained
+    for API familiarity, rarely needed in JAX."""
+    sizes_w = [layer_sizes[i] * layer_sizes[i - 1]
+               for i in range(1, len(layer_sizes))]
+    sizes_b = list(layer_sizes[1:])
+    return sizes_w, sizes_b
+
+
+def initialize_lambdas(init_weights: Optional[dict], dict_adaptive: Optional[dict]
+                       ) -> dict[str, list[Optional[jnp.ndarray]]]:
+    """Build the self-adaptive λ pytree from the user's ``init_weights`` /
+    ``dict_adaptive`` contract (reference ``utils.py:102-115`` +
+    ``models.py:95-105``).
+
+    Returns ``{"residual": [λ|None, ...], "BCs": [λ|None, ...]}`` with one
+    entry per loss term, ``None`` where the term is non-adaptive.  Unlike the
+    reference's flat list + index map (whose shared-index bug for multiple
+    adaptive residuals is catalogued in SURVEY §2.4.4), λ position is
+    structural — no index arithmetic exists to go wrong.
+    """
+    lambdas: dict[str, list[Optional[jnp.ndarray]]] = {"residual": [], "BCs": []}
+    if init_weights is None or dict_adaptive is None:
+        return lambdas
+    for key in ("residual", "BCs"):
+        flags = dict_adaptive.get(key, [])
+        inits = init_weights.get(key, [])
+        if len(flags) != len(inits):
+            raise ValueError(
+                f"dict_adaptive[{key!r}] and init_weights[{key!r}] must have "
+                f"the same length, got {len(flags)} vs {len(inits)}")
+        for flag, init in zip(flags, inits):
+            if flag and init is None:
+                raise ValueError(
+                    f"Loss term in {key!r} marked adaptive but init weight is None")
+            lambdas[key].append(
+                jnp.asarray(init, dtype=jnp.float32) if flag else None)
+    return lambdas
+
+
+def tree_copy(tree: Any) -> Any:
+    """Deep-copy a pytree of arrays (the reference's best-model tracking
+    aliases instead of copying — SURVEY §2.4.6; this is the fix)."""
+    return jax.tree_util.tree_map(jnp.array, tree)
+
+
+def to_numpy(tree: Any) -> Any:
+    return jax.tree_util.tree_map(np.asarray, tree)

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-virtual-device CPU backend.
+
+This is the "fake backend" multi-device harness the reference lacks
+(SURVEY §4): tests run on CPU with 8 XLA host devices so every sharding/
+collective path is exercised without TPU hardware.
+
+NOTE: this environment pre-imports jax via sitecustomize (axon TPU
+registration), so JAX_PLATFORMS in os.environ can be too late — we use
+jax.config.update, which works any time before first backend use.
+"""
+
+import os
+
+# Must be set before the XLA CPU client is instantiated.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("virtual 8-device CPU backend not available")
+    return devs

@@ -1,0 +1,117 @@
+"""Boundary/initial condition construction tests (reference
+``boundaries.py`` class family)."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.boundaries import (IC, FunctionDirichletBC,
+                                         FunctionNeumannBC, dirichletBC,
+                                         periodicBC)
+from tensordiffeq_tpu.domains import DomainND
+from tensordiffeq_tpu.ops.derivatives import grad
+
+
+def make_domain(nx=16, nt=9):
+    d = DomainND(["x", "t"], time_var="t")
+    d.add("x", [-1.0, 1.0], nx)
+    d.add("t", [0.0, 2.0], nt)
+    return d
+
+
+def test_dirichlet_upper_face():
+    d = make_domain()
+    bc = dirichletBC(d, val=0.5, var="x", target="upper")
+    assert bc.input.shape == (9, 2)            # t-fidelity points on the face
+    assert np.all(bc.input[:, 0] == 1.0)       # x pinned to upper bound
+    np.testing.assert_allclose(bc.input[:, 1], d.linspace("t"))
+    assert bc.val.shape == (9, 1)
+    assert np.all(bc.val == 0.5)
+    assert bc.isDirichlet and bc.isDirichlect
+
+
+def test_dirichlet_lower_face():
+    d = make_domain()
+    bc = dirichletBC(d, val=-2.0, var="x", target="lower")
+    assert np.all(bc.input[:, 0] == -1.0)
+
+
+def test_ic_mesh_and_values():
+    d = make_domain()
+    bc = IC(d, [lambda x: np.sin(x)], var=[["x"]])
+    assert bc.input.shape == (16, 2)
+    assert np.all(bc.input[:, 1] == 0.0)       # pinned at t0
+    np.testing.assert_allclose(bc.val[:, 0], np.sin(d.linspace("x")))
+
+
+def test_ic_requires_time_var():
+    d = DomainND(["x"], time_var=None)
+    d.add("x", [0, 1], 8)
+    with pytest.raises(ValueError):
+        IC(d, [lambda x: x], var=[["x"]])
+
+
+def test_ic_subsample_seeded():
+    d = make_domain()
+    a = IC(d, [np.cos], var=[["x"]], n_values=5, seed=3)
+    b = IC(d, [np.cos], var=[["x"]], n_values=5, seed=3)
+    np.testing.assert_array_equal(a.input, b.input)
+    assert a.input.shape == (5, 2)
+    assert a.val.shape == (5, 1)
+
+
+def test_function_dirichlet():
+    d = make_domain()
+    bc = FunctionDirichletBC(d, fun=[lambda t: t ** 2], var="x",
+                             target="upper", func_inputs=[["t"]])
+    assert np.all(bc.input[:, 0] == 1.0)
+    np.testing.assert_allclose(bc.val[:, 0], d.linspace("t") ** 2)
+
+
+def test_periodic_upper_lower():
+    d = make_domain()
+
+    def deriv(u, x, t):
+        return u(x, t), grad(u, "x")(x, t)
+
+    bc = periodicBC(d, ["x"], [deriv])
+    assert len(bc.upper) == 1 and len(bc.lower) == 1
+    assert np.all(bc.upper[0][:, 0] == 1.0)
+    assert np.all(bc.lower[0][:, 0] == -1.0)
+    np.testing.assert_allclose(bc.upper[0][:, 1], bc.lower[0][:, 1])
+
+
+def test_neumann_construction():
+    d = make_domain()
+
+    def du_dx(u, x, t):
+        return grad(u, "x")(x, t)
+
+    bc = FunctionNeumannBC(d, fun=[lambda t: 0.0 * t], var=["x"],
+                           target="upper", deriv_model=[du_dx],
+                           func_inputs=[["t"]])
+    assert len(bc.input) == 1
+    assert np.all(bc.input[0][:, 0] == 1.0)
+    assert bc.val[0].shape == (9, 1)
+
+
+def test_function_targets_row_aligned_with_mesh():
+    # 3-D domain: target values must align with the face mesh rows even when
+    # func_inputs order differs from domain declaration order.
+    d = DomainND(["x", "y", "t"], time_var="t")
+    d.add("x", [0.0, 1.0], 4)
+    d.add("y", [0.0, 2.0], 3)
+    d.add("t", [0.0, 1.0], 5)
+    bc = FunctionDirichletBC(d, fun=[lambda y, x: 10 * y + x], var="t",
+                             target="lower", func_inputs=[["y", "x"]])
+    expected = 10 * bc.input[:, 1] + bc.input[:, 0]
+    np.testing.assert_allclose(bc.val[:, 0], expected)
+
+
+def test_ic_values_row_aligned_3d():
+    d = DomainND(["x", "y", "t"], time_var="t")
+    d.add("x", [0.0, 1.0], 4)
+    d.add("y", [0.0, 2.0], 3)
+    d.add("t", [0.0, 1.0], 5)
+    bc = IC(d, [lambda x, y: x + 100 * y], var=[["x", "y"]])
+    np.testing.assert_allclose(bc.val[:, 0],
+                               bc.input[:, 0] + 100 * bc.input[:, 1])

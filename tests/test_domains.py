@@ -1,0 +1,53 @@
+"""DomainND tests (reference ``domains.py:5-31``)."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.domains import DomainND
+
+
+def make_domain():
+    d = DomainND(["x", "t"], time_var="t")
+    d.add("x", [-1.0, 1.0], 64)
+    d.add("t", [0.0, 1.0], 25)
+    return d
+
+
+def test_add_and_accessors():
+    d = make_domain()
+    assert d.ndim == 2
+    assert d.bounds("x") == (-1.0, 1.0)
+    assert d.fidelity("t") == 25
+    assert len(d.linspace("x")) == 64
+    np.testing.assert_allclose(d.xlimits, [[-1, 1], [0, 1]])
+
+
+def test_legacy_domaindict_keys():
+    # examples access Domain.domaindict[0]['xlinspace'] (AC-SA.py:74)
+    d = make_domain()
+    assert "xlinspace" in d.domaindict[0]
+    assert d.domaindict[0]["xupper"] == 1.0
+    assert d.domaindict[1]["tlower"] == 0.0
+
+
+def test_collocation_points():
+    d = make_domain()
+    X = d.generate_collocation_points(1000, seed=0)
+    assert X.shape == (1000, 2)
+    assert X[:, 0].min() >= -1 and X[:, 0].max() <= 1
+    assert X[:, 1].min() >= 0 and X[:, 1].max() <= 1
+    X2 = d.generate_collocation_points(1000, seed=0)
+    np.testing.assert_array_equal(X, X2)
+
+
+def test_unknown_variable_rejected():
+    d = DomainND(["x"], time_var=None)
+    with pytest.raises(ValueError):
+        d.add("y", [0, 1], 10)
+
+
+def test_generate_before_add_rejected():
+    d = DomainND(["x", "t"])
+    d.add("x", [0, 1], 10)
+    with pytest.raises(ValueError):
+        d.generate_collocation_points(10)

@@ -1,0 +1,54 @@
+"""Unit tests for loss primitives — semantics parity with reference
+``utils.py:38-48`` (weighted MSE inside/outside the mean, g_MSE)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensordiffeq_tpu.ops.losses import MSE, default_g, g_MSE, relative_l2
+from tensordiffeq_tpu.helpers import find_L2_error
+
+
+def test_mse_plain():
+    pred = jnp.array([[1.0], [3.0]])
+    actual = jnp.array([[0.0], [1.0]])
+    assert np.isclose(float(MSE(pred, actual)), (1.0 + 4.0) / 2)
+
+
+def test_mse_weights_inside_sum():
+    # type-1 SA semantics: mean((w * (pred-actual))**2)
+    pred = jnp.array([[2.0], [2.0]])
+    actual = jnp.zeros((2, 1))
+    w = jnp.array([[1.0], [3.0]])
+    expected = ((1 * 2) ** 2 + (3 * 2) ** 2) / 2
+    assert np.isclose(float(MSE(pred, actual, w)), expected)
+
+
+def test_mse_weights_outside_sum():
+    # type-2 SA semantics: w * mean((pred-actual)**2)
+    pred = jnp.array([[2.0], [4.0]])
+    actual = jnp.zeros((2, 1))
+    w = jnp.array(0.5)
+    expected = 0.5 * (4.0 + 16.0) / 2
+    assert np.isclose(float(MSE(pred, actual, w, outside_sum=True)), expected)
+
+
+def test_g_mse():
+    pred = jnp.array([[1.0], [2.0]])
+    g_lam = jnp.array([[2.0], [3.0]])
+    expected = (2 * 1 + 3 * 4) / 2
+    assert np.isclose(float(g_MSE(pred, 0.0, g_lam)), expected)
+
+
+def test_default_g_is_square():
+    assert np.isclose(float(default_g(jnp.array(3.0))), 9.0)
+
+
+def test_relative_l2_matches_helper():
+    rng = np.random.RandomState(0)
+    a, b = rng.randn(100), rng.randn(100)
+    assert np.isclose(float(relative_l2(a, b)), find_L2_error(a, b), atol=1e-6)
+
+
+def test_l2_error_zero_for_exact():
+    a = np.linspace(1, 2, 50)
+    assert find_L2_error(a, a) == 0.0

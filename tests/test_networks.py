@@ -1,0 +1,48 @@
+"""Network tests (reference ``networks.py:10-20`` parity: tanh MLP,
+glorot-normal init, linear head)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensordiffeq_tpu.networks import MLP, init_params, neural_net
+
+
+def test_shapes_and_param_count():
+    net = neural_net([2, 20, 20, 1])
+    params = init_params(net, 2, jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert n == (2 * 20 + 20) + (20 * 20 + 20) + (20 * 1 + 1)
+    y = net.apply(params, jnp.ones((7, 2)))
+    assert y.shape == (7, 1)
+
+
+def test_deterministic_init():
+    net = neural_net([2, 8, 1])
+    p1 = init_params(net, 2, jax.random.PRNGKey(1))
+    p2 = init_params(net, 2, jax.random.PRNGKey(1))
+    chex = jax.tree_util.tree_map(lambda a, b: np.array_equal(a, b), p1, p2)
+    assert all(jax.tree_util.tree_leaves(chex))
+
+
+def test_output_is_linear_head():
+    # With tanh hidden activations outputs saturate in (-1,1) per unit, but a
+    # linear head can exceed that range under scaling of final kernel.
+    net = neural_net([1, 4, 1])
+    params = init_params(net, 1, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(lambda x: x * 10.0, params)
+    y = net.apply(params, jnp.ones((1, 1)))
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_custom_activation():
+    import flax.linen as nn
+    net = MLP(layer_sizes=(2, 8, 1), activation=nn.gelu)
+    params = init_params(net, 2, jax.random.PRNGKey(0))
+    assert net.apply(params, jnp.zeros((3, 2))).shape == (3, 1)
+
+
+def test_multi_output():
+    net = neural_net([3, 16, 2])
+    params = init_params(net, 3, jax.random.PRNGKey(2))
+    assert net.apply(params, jnp.zeros((5, 3))).shape == (5, 2)

@@ -1,0 +1,61 @@
+"""Sampler tests — LHS criteria set and determinism (reference: vendored SMT
+sampler, ``sampling.py:256-534``; here scipy-qmc + re-derived ESE)."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.sampling import (LHS, LatinHypercubeSample,
+                                       OptionsDictionary, _maximin_ese, _phi_p)
+
+XLIM = np.array([[-1.0, 1.0], [0.0, 2.0]])
+
+
+def test_options_dictionary_validation():
+    opts = OptionsDictionary()
+    opts.declare("crit", default="c", values=["c", "m"])
+    opts["crit"] = "m"
+    assert opts["crit"] == "m"
+    with pytest.raises(ValueError):
+        opts["crit"] = "bogus"
+    with pytest.raises(KeyError):
+        opts["undeclared"] = 1
+
+
+def test_lhs_bounds_and_shape():
+    pts = LHS(xlimits=XLIM, random_state=0)(500)
+    assert pts.shape == (500, 2)
+    assert pts[:, 0].min() >= -1.0 and pts[:, 0].max() <= 1.0
+    assert pts[:, 1].min() >= 0.0 and pts[:, 1].max() <= 2.0
+
+
+def test_lhs_stratification():
+    # Latin hypercube property: exactly one sample per stratum per dim.
+    n = 64
+    pts = LHS(xlimits=np.array([[0.0, 1.0]]), random_state=1)(n)
+    strata = np.floor(pts[:, 0] * n).astype(int)
+    assert sorted(strata.tolist()) == list(range(n))
+
+
+def test_lhs_determinism():
+    a = LHS(xlimits=XLIM, random_state=42)(100)
+    b = LHS(xlimits=XLIM, random_state=42)(100)
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("crit", ["c", "m", "cm", "corr", "ese", None])
+def test_lhs_criteria_all_run(crit):
+    pts = LHS(xlimits=XLIM, criterion=crit, random_state=3)(40)
+    assert pts.shape == (40, 2)
+    assert np.isfinite(pts).all()
+
+
+def test_ese_improves_phi_p():
+    rng = np.random.RandomState(0)
+    X = rng.rand(30, 2)
+    X_opt = _maximin_ese(X.copy(), np.random.RandomState(1))
+    assert _phi_p(X_opt) <= _phi_p(X) + 1e-12
+
+
+def test_latin_hypercube_sample_helper():
+    pts = LatinHypercubeSample(200, XLIM, seed=7)
+    assert pts.shape == (200, 2)

@@ -1,0 +1,176 @@
+"""End-to-end solver tests: tiny Burgers problems through compile/fit/predict
+— the integration layer the reference only exercised via example scripts
+(SURVEY §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import (IC, CollocationSolverND, DomainND, dirichletBC,
+                              grad, periodicBC)
+
+
+def make_burgers(n_f=512, nx=32, nt=11, seed=0):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], nx)
+    domain.add("t", [0.0, 1.0], nt)
+    domain.generate_collocation_points(n_f, seed=seed)
+    init = IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])
+    bcs = [init,
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        u_xx = grad(u_x, "x")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
+
+    return domain, bcs, f_model
+
+
+def test_compile_and_initial_loss():
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    total, comps = s.update_loss()
+    assert np.isfinite(float(total))
+    assert set(comps) == {"BC_0", "BC_1", "BC_2", "Residual_0", "Total Loss"}
+    assert np.isclose(float(total),
+                      sum(float(comps[k]) for k in comps if k != "Total Loss"),
+                      rtol=1e-5)
+
+
+def test_adam_reduces_loss_and_history():
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    t0, _ = s.update_loss()
+    s.fit(tf_iter=100, newton_iter=0, chunk=50)
+    t1, _ = s.update_loss()
+    assert float(t1) < float(t0)
+    assert len(s.losses) == 100
+    assert s.min_loss["adam"] <= float(t0)
+    assert s.best_model["adam"] is not None
+
+
+def test_lbfgs_phase_improves():
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    s.fit(tf_iter=60, newton_iter=40, chunk=30)
+    assert s.min_loss["l-bfgs"] < s.min_loss["adam"]
+    assert s.min_loss["overall"] == s.min_loss["l-bfgs"]
+
+
+def test_predict_shapes():
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    X_star = np.random.RandomState(0).rand(77, 2).astype(np.float32)
+    u, f = s.predict(X_star)
+    assert u.shape == (77, 1)
+    assert np.shape(f) == (77,)
+
+
+def test_minibatch_runs_all_batches():
+    domain, bcs, f_model = make_burgers(n_f=512)
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    s.fit(tf_iter=20, newton_iter=0, batch_sz=128, chunk=10)
+    assert len(s.losses) == 20  # one history entry per epoch
+
+
+def test_sa_weights_update_by_ascent():
+    domain, bcs, f_model = make_burgers(n_f=256)
+    n_ic = 32
+    init_weights = {"residual": [np.random.RandomState(0).rand(256, 1)],
+                    "BCs": [100 * np.random.RandomState(1).rand(n_ic, 1),
+                            None, None]}
+    dict_adaptive = {"residual": [True], "BCs": [True, False, False]}
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, Adaptive_type=1,
+              dict_adaptive=dict_adaptive, init_weights=init_weights)
+    lam0 = np.asarray(s.lambdas["residual"][0]).copy()
+    s.fit(tf_iter=30, newton_iter=0, chunk=15)
+    lam1 = np.asarray(s.lambdas["residual"][0])
+    assert not np.allclose(lam0, lam1)          # λ actually trained
+    assert np.mean(lam1) > np.mean(lam0) - 1e-3  # ascent, not descent
+
+
+def test_sa_validation_errors():
+    domain, bcs, f_model = make_burgers(n_f=64)
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError):
+        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1)
+    with pytest.raises(ValueError):
+        s.compile([2, 8, 1], f_model, domain, bcs,
+                  dict_adaptive={"residual": [True], "BCs": [False] * 3})
+    with pytest.raises(NotImplementedError):
+        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=3)
+
+
+def test_adaptive_periodic_rejected():
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+
+    def deriv(u, x, t):
+        return u(x, t), grad(u, "x")(x, t)
+
+    bcs = [IC(domain, [lambda x: x], var=[["x"]]),
+           periodicBC(domain, ["x"], [deriv])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError):
+        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+                  dict_adaptive={"residual": [False], "BCs": [False, True]},
+                  init_weights={"residual": [None], "BCs": [None, np.ones((16, 1))]})
+
+
+def test_periodic_bc_trains():
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(128, seed=0)
+
+    def deriv(u, x, t):
+        return u(x, t), grad(u, "x")(x, t)
+
+    bcs = [IC(domain, [lambda x: np.cos(np.pi * x)], var=[["x"]]),
+           periodicBC(domain, ["x"], [deriv])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * d_xx(u)(x, t)
+
+    from tensordiffeq_tpu import d as d_op
+
+    def d_xx(u):
+        return d_op(u, "x", 2)
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 1], f_model, domain, bcs)
+    t0, _ = s.update_loss()
+    s.fit(tf_iter=40, newton_iter=0, chunk=20)
+    t1, _ = s.update_loss()
+    assert float(t1) < float(t0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    domain, bcs, f_model = make_burgers(n_f=128)
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs)
+    s.fit(tf_iter=10, newton_iter=0, chunk=10)
+    path = str(tmp_path / "weights.msgpack")
+    s.save(path)
+    X = np.random.RandomState(0).rand(10, 2).astype(np.float32)
+    u1, _ = s.predict(X)
+
+    s2 = CollocationSolverND(verbose=False)
+    s2.compile([2, 8, 1], f_model, domain, bcs)
+    s2.load_model(path)
+    u2, _ = s2.predict(X)
+    np.testing.assert_allclose(u1, u2, atol=1e-6)

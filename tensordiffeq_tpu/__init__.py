@@ -35,7 +35,7 @@ from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
 from .domains import DomainND  # noqa: F401
 from .helpers import find_L2_error  # noqa: F401
-from .models import CollocationSolverND  # noqa: F401
+from .models import CollocationSolverND, DiscoveryModel  # noqa: F401
 from .networks import MLP, neural_net  # noqa: F401
 from .ops import MSE, UFn, d, g_MSE, grad, laplacian  # noqa: F401
 

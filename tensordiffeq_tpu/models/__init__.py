@@ -1,5 +1,6 @@
 """Solver APIs: forward collocation and inverse discovery models."""
 
 from .collocation import CollocationSolverND  # noqa: F401
+from .discovery import DiscoveryModel  # noqa: F401
 
-__all__ = ["CollocationSolverND"]
+__all__ = ["CollocationSolverND", "DiscoveryModel"]

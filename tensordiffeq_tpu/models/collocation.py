@@ -122,6 +122,21 @@ class CollocationSolverND:
             if all(not any(v) for v in dict_adaptive.values()):
                 raise ValueError("Adaptive method was selected but no loss "
                                  "was marked to be adaptive")
+            # tolerate omitted keys (treated as all-non-adaptive), but reject
+            # wrong lengths with a clear message instead of a bare KeyError
+            dict_adaptive = {
+                "residual": list(dict_adaptive.get("residual", [])),
+                "BCs": list(dict_adaptive.get("BCs", [False] * len(self.bcs))),
+            }
+            init_weights = {
+                "residual": list(init_weights.get("residual", [])),
+                "BCs": list(init_weights.get("BCs", [None] * len(self.bcs))),
+            }
+            if len(dict_adaptive["BCs"]) != len(self.bcs):
+                raise ValueError(
+                    f"dict_adaptive['BCs'] has {len(dict_adaptive['BCs'])} "
+                    f"entries but {len(self.bcs)} boundary conditions were "
+                    "passed")
             for i, bc in enumerate(self.bcs):
                 if dict_adaptive["BCs"][i] and (bc.isPeriodic or bc.isNeumann):
                     kind = "periodic" if bc.isPeriodic else "Neumann"
@@ -164,8 +179,19 @@ class CollocationSolverND:
             raise ValueError(
                 "Assimilate needs to be set to 'true' for data assimilation. "
                 "Re-initialize CollocationSolverND with assimilate=True.")
-        x = np.reshape(x, (len(np.ravel(x)) // max(self.domain.ndim - 1, 1), -1))
+        # normalise spatial coords: accept an [n, d-1] array or a list of
+        # per-variable columns (hstack column-wise; a plain reshape would
+        # interleave coordinates for multi-dimensional spatial input)
+        if isinstance(x, (list, tuple)):
+            x = np.hstack([np.reshape(c, (-1, 1)) for c in x])
+        else:
+            x = np.reshape(np.asarray(x), (np.shape(np.ravel(x))[0] //
+                                           max(self.domain.ndim - 1, 1), -1))
         t = np.reshape(t, (-1, 1))
+        if x.shape[0] != t.shape[0]:
+            raise ValueError(
+                f"compile_data: {x.shape[0]} spatial rows vs {t.shape[0]} "
+                "time rows")
         self.data_X = jnp.asarray(np.hstack([x, t]), jnp.float32)
         self.data_s = jnp.asarray(np.reshape(y, (-1, self.n_out)), jnp.float32)
         if self._compiled:

@@ -1,0 +1,196 @@
+"""Inverse-problem solver: learn PDE coefficients from observed data.
+
+TPU-native counterpart of the reference ``DiscoveryModel``
+(``models.py:324-398``).  The reference juggles three Adam optimizers and
+fragile gradient-list slicing (``grads[-(len_+1)]`` index arithmetic —
+SURVEY §2.4.9); here the unknowns are just extra leaves of one trainable
+pytree ``{"params", "vars", "col_weights"}`` routed through a single
+``optax.multi_transform``: Adam on the network, Adam on the coefficients,
+Adam-*ascent* on the SA collocation weights (the ``-grads`` minimax of
+reference ``models.py:369``).
+
+User contract (JAX-style, per-point)::
+
+    def f_model(u, var, x, t):
+        c1, c2 = var
+        u_xx = grad(grad(u, "x"), "x")
+        return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * u(x, t)**3
+
+against observations ``u`` at points ``X`` (reference example:
+``examples/AC-discovery.py:18-26``).  The SA residual weighting uses
+``g(λ)=λ²`` exactly as the reference does (``models.py:348``).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..networks import neural_net
+from ..ops.derivatives import make_ufn
+from ..ops.losses import MSE, g_MSE
+from ..output import print_screen
+from ..training.progress import progress_bar
+
+
+class DiscoveryModel:
+    """Learn PDE coefficients ``var`` jointly with the solution network."""
+
+    def compile(self, layer_sizes: Sequence[int], f_model: Callable, X, u,
+                var: Sequence[float], col_weights=None,
+                varnames: Optional[Sequence[str]] = None,
+                lr: float = 0.005, lr_vars: float = 0.005,
+                lr_weights: float = 0.005, seed: int = 0, verbose: bool = True):
+        """Assemble the inverse problem (reference ``models.py:325-341``).
+
+        Args:
+          layer_sizes: MLP sizes ``[n_in, …, n_out]``.
+          f_model: per-point residual ``f_model(u, var, *coords)``.
+          X: observation coordinates — ``[n, d]`` array or list of ``d``
+            column vectors (the reference passes a column list,
+            ``examples/AC-discovery.py:51``).
+          u: observed solution values ``[n, n_out]``.
+          var: initial guesses for the unknown coefficients.
+          col_weights: optional SA collocation weights ``[n, 1]`` (λ², with
+            gradient ascent — reference ``models.py:348,369``).
+          varnames: coordinate names for ``grad(u, "x")`` style authoring
+            (defaults to ``x0, x1, …``).
+        """
+        if isinstance(X, (list, tuple)):
+            X = np.hstack([np.reshape(c, (-1, 1)) for c in X])
+        self.X = jnp.asarray(X, jnp.float32)
+        self.ndim = int(self.X.shape[1])
+        self.u_data = jnp.asarray(np.reshape(u, (self.X.shape[0], -1)),
+                                  jnp.float32)
+        self.layer_sizes = list(layer_sizes)
+        self.n_out = int(layer_sizes[-1])
+        self.f_model = f_model
+        self.varnames = tuple(varnames) if varnames is not None else tuple(
+            f"x{i}" for i in range(self.ndim))
+        if len(self.varnames) != self.ndim:
+            raise ValueError(
+                f"X has {self.ndim} coordinate column(s) but varnames names "
+                f"{len(self.varnames)}: {self.varnames}")
+        self.verbose = verbose
+
+        self.net = neural_net(layer_sizes)
+        self.params = self.net.init(jax.random.PRNGKey(seed),
+                                    jnp.zeros((1, self.ndim), jnp.float32))
+        self.apply_fn = self.net.apply
+
+        self.trainables = {
+            "params": self.params,
+            "vars": [jnp.asarray(v, jnp.float32) for v in var],
+            "col_weights": (None if col_weights is None
+                            else jnp.asarray(col_weights, jnp.float32)),
+        }
+
+        def label_fn(tr):
+            return {"params": jax.tree_util.tree_map(lambda _: "net", tr["params"]),
+                    "vars": jax.tree_util.tree_map(lambda _: "vars", tr["vars"]),
+                    "col_weights": jax.tree_util.tree_map(lambda _: "lam",
+                                                          tr["col_weights"])}
+
+        self.opt = optax.multi_transform(
+            {"net": optax.adam(lr, b1=0.99),
+             "vars": optax.adam(lr_vars, b1=0.99),
+             "lam": optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=0.99))},
+            label_fn)
+        self.opt_state = self.opt.init(self.trainables)
+        self._build()
+        return self
+
+    # ------------------------------------------------------------------ #
+    def _build(self):
+        X, u_data, ndim = self.X, self.u_data, self.ndim
+        apply_fn, varnames, n_out = self.apply_fn, self.varnames, self.n_out
+        f_model = self.f_model
+
+        def loss_fn(tr):
+            u = make_ufn(apply_fn, tr["params"], varnames, n_out)
+            u_pred = apply_fn(tr["params"], X)
+
+            def per_point(pt):
+                return f_model(u, tr["vars"], *(pt[i] for i in range(ndim)))
+
+            f_pred = jax.vmap(per_point)(X)
+            f_pred = f_pred.reshape(-1, 1)
+            data_loss = MSE(u_pred, u_data)
+            if tr["col_weights"] is not None:
+                res_loss = g_MSE(f_pred, 0.0, tr["col_weights"] ** 2)
+            else:
+                res_loss = MSE(f_pred, 0.0)
+            return data_loss + res_loss, {"Data": data_loss,
+                                          "Residual": res_loss}
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        opt = self.opt
+
+        @partial(jax.jit, static_argnames=("n_steps",))
+        def run_chunk(trainables, opt_state, n_steps: int):
+            def step(carry, _):
+                trainables, opt_state = carry
+                (total, _), grads = grad_fn(trainables)
+                updates, opt_state = opt.update(grads, opt_state, trainables)
+                trainables = optax.apply_updates(trainables, updates)
+                return (trainables, opt_state), (total,
+                                                 [v for v in trainables["vars"]])
+
+            (trainables, opt_state), (losses, var_hist) = jax.lax.scan(
+                step, (trainables, opt_state), None, length=n_steps)
+            return trainables, opt_state, losses, var_hist
+
+        self._run_chunk = run_chunk
+        self.loss_fn = loss_fn
+
+    # ------------------------------------------------------------------ #
+    @property
+    def vars(self) -> list[np.ndarray]:
+        """Current coefficient estimates."""
+        return [np.asarray(v) for v in self.trainables["vars"]]
+
+    @property
+    def col_weights(self):
+        cw = self.trainables["col_weights"]
+        return None if cw is None else np.asarray(cw)
+
+    def fit(self, tf_iter: int, chunk: int = 100):
+        """Joint Adam training loop (reference ``models.py:381-398``)."""
+        self.train_loop(tf_iter, chunk=chunk)
+        return self
+
+    def train_loop(self, tf_iter: int, chunk: int = 100):
+        if self.verbose:
+            print_screen(self, discovery_model=True)
+        self.losses: list[float] = []
+        self.var_history: list[list[float]] = []
+        t0 = time.time()
+        pbar = progress_bar(tf_iter, desc="Discovery") if self.verbose else None
+        done = 0
+        while done < tf_iter:
+            n = int(min(chunk, tf_iter - done))
+            self.trainables, self.opt_state, losses, var_hist = self._run_chunk(
+                self.trainables, self.opt_state, n)
+            self.losses.extend(np.asarray(losses).tolist())
+            stacked = [np.asarray(v) for v in var_hist]
+            for i in range(n):
+                self.var_history.append([float(v[i]) for v in stacked])
+            done += n
+            if pbar is not None:
+                pbar.update(n)
+                pbar.set_postfix(loss=self.losses[-1],
+                                 vars=[round(v, 4) for v in self.var_history[-1]])
+        if pbar is not None:
+            pbar.close()
+        self.wall_time = time.time() - t0
+
+    # ------------------------------------------------------------------ #
+    def predict(self, X_star):
+        X_star = jnp.asarray(X_star, jnp.float32)
+        return np.asarray(self.apply_fn(self.trainables["params"], X_star))

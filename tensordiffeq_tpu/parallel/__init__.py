@@ -74,13 +74,17 @@ def shard_data_inputs(X_f, lambdas: dict, mesh: Optional[Mesh] = None):
               f"{n_dev} devices")
     X_sharded = jax.device_put(X_f[:N_keep], data_sharding(mesh, X_f.ndim))
 
-    def place(lam):
+    def place(lam, per_point_ok):
         if lam is None:
             return None
-        if lam.shape and int(lam.shape[0]) == N:  # per-point λ rides its shard
+        # Route structurally: only *residual* λ can be per-point (they are
+        # row-aligned with X_f); BC λ always align with their face meshes and
+        # must be replicated even if their length coincides with N.
+        if per_point_ok and lam.ndim >= 1 and int(lam.shape[0]) == N:
             return jax.device_put(lam[:N_keep], data_sharding(mesh, lam.ndim))
         return jax.device_put(lam, replicated(mesh))
 
-    placed = {key: [place(lam) for lam in terms]
+    placed = {key: [place(lam, per_point_ok=(key == "residual"))
+                    for lam in terms]
               for key, terms in lambdas.items()}
     return X_sharded, placed

@@ -180,9 +180,10 @@ def _phi_p(X: np.ndarray, p: float = 10.0) -> float:
 
 def _phi_p_swap(X: np.ndarray, phi: float, k: int, i1: int, i2: int,
                 p: float) -> float:
-    """PhiP after swapping rows ``i1``/``i2`` in column ``k``, updated
-    incrementally in O(n) (reference ``sampling.py:465-513`` does the same
-    rank-1 update; re-derived from the PhiP definition)."""
+    """PhiP value X would have after swapping rows ``i1``/``i2`` in column
+    ``k``, computed incrementally in O(n) without modifying ``X``
+    (the rank-1 update idea of reference ``sampling.py:465-513``,
+    re-derived from the PhiP definition as a pure function)."""
     n = X.shape[0]
     mask = np.ones(n, dtype=bool)
     mask[[i1, i2]] = False
@@ -199,7 +200,6 @@ def _phi_p_swap(X: np.ndarray, phi: float, k: int, i1: int, i2: int,
     res = (phi ** p
            + (d1_new ** (-p) - d1_old ** (-p)).sum()
            + (d2_new ** (-p) - d2_old ** (-p)).sum())
-    X[i1], X[i2] = X1_new, X2_new
     return float(max(res, 0.0) ** (1.0 / p))
 
 
@@ -235,8 +235,7 @@ def _maximin_ese(X: np.ndarray, rng: np.random.RandomState, p: float = 10.0,
             best_try_phi, best_pair = np.inf, None
             for _ in range(J):
                 i1, i2 = rng.choice(n, size=2, replace=False)
-                Xt = X.copy()
-                phi_try = _phi_p_swap(Xt, phi, k, i1, i2, p)
+                phi_try = _phi_p_swap(X, phi, k, i1, i2, p)
                 if phi_try < best_try_phi:
                     best_try_phi, best_pair = phi_try, (i1, i2)
             i1, i2 = best_pair

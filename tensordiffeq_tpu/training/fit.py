@@ -69,7 +69,7 @@ class FitResult:
 
 
 def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
-                  n_batches: int):
+                  n_batches: int, n_points: int):
     """Build the jitted multi-step runner.
 
     Returns ``run(trainables, opt_state, best, X_batched, idx_batched,
@@ -81,12 +81,17 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
     reference's aliasing best-model bug (SURVEY §2.4.6).
     """
 
+    def _is_per_point(lam):
+        return lam is not None and lam.ndim >= 1 and lam.shape[0] == n_points
+
     def loss_over_trainables(trainables, X_b, idx_b):
         lambdas = trainables["lambdas"]
         if n_batches == 1:
             lam_res = lambdas["residual"]
         else:
-            lam_res = [None if lam is None else lam[idx_b]
+            # gather only per-point λ alongside their batch rows; scalar
+            # (type-2) λ apply to the whole term and pass through untouched
+            lam_res = [lam[idx_b] if _is_per_point(lam) else lam
                        for lam in lambdas["residual"]]
         return loss_fn(trainables["params"], lambdas["BCs"], lam_res, X_b)
 
@@ -153,7 +158,7 @@ def fit_adam(loss_fn: Callable,
     opt = make_optimizer(lr, lr_weights)
     trainables = {"params": params, "lambdas": lambdas}
     opt_state = opt.init(trainables)
-    run = _chunk_runner(loss_fn, opt, n_batches)
+    run = _chunk_runner(loss_fn, opt, n_batches, n_batches * bsz)
 
     best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
     total_steps = tf_iter * n_batches

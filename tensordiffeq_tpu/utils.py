@@ -88,8 +88,16 @@ def initialize_lambdas(init_weights: Optional[dict], dict_adaptive: Optional[dic
             if flag and init is None:
                 raise ValueError(
                     f"Loss term in {key!r} marked adaptive but init weight is None")
-            lambdas[key].append(
-                jnp.asarray(init, dtype=jnp.float32) if flag else None)
+            if not flag:
+                lambdas[key].append(None)
+                continue
+            lam = jnp.asarray(init, dtype=jnp.float32)
+            # normalise per-point weight vectors to column shape [n, 1]: a
+            # 1-D (n,) λ would silently broadcast against (n, 1) errors into
+            # an (n, n) outer product inside MSE
+            if lam.ndim == 1 and lam.shape[0] > 1:
+                lam = lam.reshape(-1, 1)
+            lambdas[key].append(lam)
     return lambdas
 
 

@@ -1,0 +1,69 @@
+"""DiscoveryModel tests: recover known PDE coefficients from synthetic data
+(the reference ships this untested; its example is ``AC-discovery.py``)."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import DiscoveryModel, grad
+
+
+def synthetic_heat_data(n=400, seed=0):
+    # u(x,t) = sin(pi x) exp(-t) satisfies u_t = -(1/pi^2)*... actually
+    # u_t = -u and u_xx = -pi^2 u, so u_t - c*u_xx = 0 with c = 1/pi^2.
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 1))
+    t = rng.uniform(0, 1, (n, 1))
+    u = np.sin(np.pi * x) * np.exp(-t)
+    return x, t, u
+
+
+def f_model(u, var, x, t):
+    c = var[0]
+    u_xx = grad(grad(u, "x"), "x")
+    return grad(u, "t")(x, t) - c * u_xx(x, t)
+
+
+TRUE_C = 1 / np.pi ** 2
+
+
+def test_discovery_recovers_coefficient():
+    x, t, u = synthetic_heat_data()
+    model = DiscoveryModel()
+    model.compile([2, 20, 20, 1], f_model, [x, t], u, var=[0.0],
+                  varnames=["x", "t"], verbose=False)
+    model.fit(tf_iter=2000, chunk=500)
+    c_est = float(model.vars[0])
+    assert abs(c_est - TRUE_C) < 0.05, f"estimated {c_est}, true {TRUE_C}"
+    assert model.losses[-1] < model.losses[0]
+    assert len(model.var_history) == 2000
+
+
+def test_discovery_with_sa_col_weights():
+    x, t, u = synthetic_heat_data(n=200)
+    cw = np.random.RandomState(1).rand(200, 1)
+    model = DiscoveryModel()
+    model.compile([2, 16, 1], f_model, [x, t], u, var=[0.1],
+                  col_weights=cw, varnames=["x", "t"], verbose=False)
+    model.fit(tf_iter=200, chunk=100)
+    assert model.col_weights is not None
+    assert not np.allclose(model.col_weights, cw)  # λ trained (ascent)
+    assert np.isfinite(model.losses[-1])
+
+
+def test_discovery_predict():
+    x, t, u = synthetic_heat_data(n=100)
+    model = DiscoveryModel()
+    model.compile([2, 8, 1], f_model, [x, t], u, var=[0.0],
+                  varnames=["x", "t"], verbose=False)
+    model.fit(tf_iter=50, chunk=50)
+    pred = model.predict(np.hstack([x, t]))
+    assert pred.shape == (100, 1)
+
+
+def test_discovery_accepts_stacked_X():
+    x, t, u = synthetic_heat_data(n=64)
+    model = DiscoveryModel()
+    model.compile([2, 8, 1], f_model, np.hstack([x, t]), u, var=[0.0],
+                  varnames=["x", "t"], verbose=False)
+    model.fit(tf_iter=10, chunk=10)
+    assert len(model.vars) == 1

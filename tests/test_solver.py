@@ -97,6 +97,41 @@ def test_sa_weights_update_by_ascent():
     assert np.mean(lam1) > np.mean(lam0) - 1e-3  # ascent, not descent
 
 
+def test_type2_scalar_weights_with_minibatch():
+    # regression: scalar (type-2) λ must pass through the minibatch gather
+    domain, bcs, f_model = make_burgers(n_f=256)
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=2,
+              dict_adaptive={"residual": [True], "BCs": [False] * 3},
+              init_weights={"residual": [1.0], "BCs": [None] * 3})
+    s.fit(tf_iter=10, newton_iter=0, batch_sz=64, chunk=5)
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+
+
+def test_one_dim_weight_vector_normalized():
+    # regression: a 1-D (n,) λ must not broadcast into an (n, n) outer product
+    from tensordiffeq_tpu.utils import initialize_lambdas
+    lams = initialize_lambdas({"residual": [np.ones(64)], "BCs": []},
+                              {"residual": [True], "BCs": []})
+    assert lams["residual"][0].shape == (64, 1)
+
+
+def test_dict_adaptive_missing_bcs_key():
+    # regression: omitted "BCs" key is tolerated; wrong length is a clear error
+    domain, bcs, f_model = make_burgers(n_f=128)
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+              dict_adaptive={"residual": [True]},
+              init_weights={"residual": [np.ones((128, 1))]})
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s2 = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError, match="entries but"):
+        s2.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+                   dict_adaptive={"residual": [True], "BCs": [True]},
+                   init_weights={"residual": [np.ones((128, 1))],
+                                 "BCs": [np.ones((32, 1))]})
+
+
 def test_sa_validation_errors():
     domain, bcs, f_model = make_burgers(n_f=64)
     s = CollocationSolverND(verbose=False)
@@ -157,6 +192,25 @@ def test_periodic_bc_trains():
     s.fit(tf_iter=40, newton_iter=0, chunk=20)
     t1, _ = s.update_loss()
     assert float(t1) < float(t0)
+
+
+def test_assimilation_loss_term_active():
+    # the reference stores assimilation data but never uses it (SURVEY §3.6);
+    # here it must appear as a real "Data" loss component and train
+    domain, bcs, f_model = make_burgers(n_f=128)
+    s = CollocationSolverND(assimilate=True, verbose=False)
+    s.compile([2, 10, 1], f_model, domain, bcs)
+    rng = np.random.RandomState(0)
+    x_d = rng.uniform(-1, 1, (50, 1))
+    t_d = rng.uniform(0, 1, (50, 1))
+    u_d = -np.sin(np.pi * x_d) * (1 - t_d)
+    s.compile_data(x_d, t_d, u_d)
+    total, comps = s.update_loss()
+    assert "Data" in comps
+    assert float(comps["Data"]) > 0
+    s.fit(tf_iter=40, newton_iter=0, chunk=20)
+    _, comps2 = s.update_loss()
+    assert float(comps2["Data"]) < float(comps["Data"])
 
 
 def test_save_load_roundtrip(tmp_path):

@@ -1,0 +1,149 @@
+"""High-accuracy reference solutions for the canonical benchmark PDEs.
+
+The reference ships binary fixtures (``examples/AC.mat`` — a 512x201
+Allen-Cahn spectral solution loaded at ``examples/AC-baseline.py:55`` — and
+``examples/burgers_shock.mat``, ``examples/burgers-new.py:48``) but not the
+code that produced them.  Here the fixtures are *generated*, reproducibly:
+
+* :func:`allen_cahn_solution` — Fourier pseudo-spectral discretisation +
+  ETDRK4 exponential time integrator (Kassam & Trefethen 2005) for
+  ``u_t = 1e-4 u_xx + 5(u - u^3)`` with periodic BCs on x in [-1, 1].
+* :func:`burgers_solution` — the Cole–Hopf closed form for
+  ``u_t + u u_x = nu u_xx``, ``u(x,0) = -sin(pi x)``, evaluated with
+  Gauss–Hermite quadrature (the classical evaluation used by Basdevant et
+  al. 1986 for exactly this nu = 0.01/pi shock benchmark).
+
+Solutions are memoised to ``.npz`` files under a cache directory so tests,
+examples and ``bench.py`` pay the (CPU, seconds-scale) cost once.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "_fixture_cache")
+
+
+def _cache_path(name: str) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    return os.path.join(_CACHE_DIR, name + ".npz")
+
+
+def _memoise(name, builder):
+    path = _cache_path(name)
+    if os.path.exists(path):
+        with np.load(path) as z:
+            return z["x"], z["t"], z["u"]
+    x, t, u = builder()
+    np.savez_compressed(path, x=x, t=t, u=u)
+    return x, t, u
+
+
+# --------------------------------------------------------------------------- #
+# Allen-Cahn: Fourier spectral + ETDRK4
+# --------------------------------------------------------------------------- #
+def _etdrk4_allen_cahn(nx: int, nt: int, t_final: float, eps: float,
+                       dt: float):
+    """Integrate u_t = eps*u_xx + 5u - 5u^3, periodic on [-1, 1)."""
+    x = -1.0 + 2.0 * np.arange(nx) / nx           # periodic grid (no endpoint)
+    u = x ** 2 * np.cos(np.pi * x)                # reference IC (AC-SA paper)
+    v = np.fft.fft(u)
+
+    # wavenumbers for period L = 2
+    k = np.fft.fftfreq(nx, d=1.0 / nx) * np.pi    # 2*pi*m/L with L=2
+    L = -eps * k ** 2 + 5.0                       # linear operator symbol
+    E = np.exp(dt * L)
+    E2 = np.exp(dt * L / 2.0)
+
+    # ETDRK4 scalar coefficients via complex contour integral (Kassam-Trefethen)
+    M = 32
+    r = np.exp(1j * np.pi * (np.arange(1, M + 1) - 0.5) / M)
+    LR = dt * L[:, None] + r[None, :]
+    Q = dt * np.real(np.mean((np.exp(LR / 2) - 1) / LR, axis=1))
+    f1 = dt * np.real(np.mean(
+        (-4 - LR + np.exp(LR) * (4 - 3 * LR + LR ** 2)) / LR ** 3, axis=1))
+    f2 = dt * np.real(np.mean(
+        (2 + LR + np.exp(LR) * (-2 + LR)) / LR ** 3, axis=1))
+    f3 = dt * np.real(np.mean(
+        (-4 - 3 * LR - LR ** 2 + np.exp(LR) * (4 - LR)) / LR ** 3, axis=1))
+
+    def N(vhat):
+        uu = np.real(np.fft.ifft(vhat))
+        return np.fft.fft(-5.0 * uu ** 3)
+
+    n_steps = int(round(t_final / dt))
+    save_every = max(1, n_steps // (nt - 1))
+    # adjust dt so that n_steps is an exact multiple of (nt - 1)
+    assert n_steps % (nt - 1) == 0, "choose dt dividing t_final/(nt-1)"
+
+    out = np.empty((nx, nt))
+    out[:, 0] = u
+    j = 1
+    for n in range(1, n_steps + 1):
+        Nv = N(v)
+        a = E2 * v + Q * Nv
+        Na = N(a)
+        b = E2 * v + Q * Na
+        Nb = N(b)
+        c = E2 * a + Q * (2 * Nb - Nv)
+        Nc = N(c)
+        v = E * v + Nv * f1 + 2 * (Na + Nb) * f2 + Nc * f3
+        if n % save_every == 0:
+            out[:, j] = np.real(np.fft.ifft(v))
+            j += 1
+    assert j == nt
+    return x, out
+
+
+def allen_cahn_solution(nx: int = 512, nt: int = 201, t_final: float = 1.0,
+                        eps: float = 1e-4):
+    """Allen-Cahn benchmark solution on a ``(nx, nt)`` grid.
+
+    Returns ``(x, t, usol)`` with ``x`` shape (nx,), ``t`` shape (nt,),
+    ``usol`` shape (nx, nt) — same layout as the reference's ``AC.mat``
+    (``examples/AC-baseline.py:55-63``).
+    """
+    def build():
+        # dt = t_final / (k*(nt-1)) with enough substeps for ETDRK4 accuracy
+        substeps = 10  # 2000 total steps: well inside ETDRK4's stability
+        dt = t_final / ((nt - 1) * substeps)
+        x, u = _etdrk4_allen_cahn(nx, nt, t_final, eps, dt)
+        t = np.linspace(0.0, t_final, nt)
+        return x, t, u
+
+    return _memoise(f"allen_cahn_{nx}x{nt}_{eps:g}", build)
+
+
+# --------------------------------------------------------------------------- #
+# Burgers: Cole-Hopf with Gauss-Hermite quadrature
+# --------------------------------------------------------------------------- #
+def burgers_solution(nx: int = 256, nt: int = 100, nu: float = 0.01 / np.pi,
+                     n_quad: int = 100):
+    """Exact viscous-Burgers solution ``u_t + u u_x = nu u_xx`` with
+    ``u(x, 0) = -sin(pi x)`` on [-1, 1] (homogeneous Dirichlet by symmetry).
+
+    Cole–Hopf:  u(x,t) = -∫ sin(pi(x-z)) f(x-z) G(z) dz / ∫ f(x-z) G(z) dz
+    with f(y) = exp(-cos(pi y)/(2 pi nu)), G the heat kernel; substituting
+    z = sqrt(4 nu t) s gives Gauss–Hermite form.  Returns ``(x, t, usol)``
+    with ``usol`` shape (nx, nt); t starts at 0 (IC row exact).
+    """
+    def build():
+        x = np.linspace(-1.0, 1.0, nx)
+        t = np.linspace(0.0, 1.0, nt)
+        s_nodes, s_weights = np.polynomial.hermite.hermgauss(n_quad)
+        u = np.empty((nx, nt))
+        u[:, 0] = -np.sin(np.pi * x)
+        c = 1.0 / (2.0 * np.pi * nu)
+        for j, tj in enumerate(t[1:], start=1):
+            a = np.sqrt(4.0 * nu * tj)
+            # y[i, q] = x_i - a*s_q
+            y = x[:, None] - a * s_nodes[None, :]
+            f = np.exp(-c * np.cos(np.pi * y))
+            num = -(np.sin(np.pi * y) * f) @ s_weights
+            den = f @ s_weights
+            u[:, j] = num / den
+        return x, t, u
+
+    return _memoise(f"burgers_{nx}x{nt}_{nu:g}_{n_quad}", build)

@@ -123,7 +123,15 @@ class CollocationSolverND:
                 raise ValueError("Adaptive method was selected but no loss "
                                  "was marked to be adaptive")
             # tolerate omitted keys (treated as all-non-adaptive), but reject
-            # wrong lengths with a clear message instead of a bare KeyError
+            # unknown keys (silently dropping a misspelled 'bcs' would turn
+            # the user's adaptivity off) and wrong lengths with clear messages
+            for name, dct in (("dict_adaptive", dict_adaptive),
+                              ("init_weights", init_weights)):
+                unknown = set(dct) - {"residual", "BCs"}
+                if unknown:
+                    raise ValueError(
+                        f"{name} has unknown key(s) {sorted(unknown)}; "
+                        "expected only 'residual' and 'BCs'")
             dict_adaptive = {
                 "residual": list(dict_adaptive.get("residual", [])),
                 "BCs": list(dict_adaptive.get("BCs", [False] * len(self.bcs))),

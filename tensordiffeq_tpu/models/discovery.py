@@ -33,7 +33,7 @@ import numpy as np
 import optax
 
 from ..networks import neural_net
-from ..ops.derivatives import make_ufn
+from ..ops.derivatives import make_ufn, vmap_residual
 from ..ops.losses import MSE, g_MSE
 from ..output import print_screen
 from ..training.progress import progress_bar
@@ -116,18 +116,22 @@ class DiscoveryModel:
             u = make_ufn(apply_fn, tr["params"], varnames, n_out)
             u_pred = apply_fn(tr["params"], X)
 
-            def per_point(pt):
-                return f_model(u, tr["vars"], *(pt[i] for i in range(ndim)))
-
-            f_pred = jax.vmap(per_point)(X)
-            f_pred = f_pred.reshape(-1, 1)
+            f_pred = vmap_residual(
+                lambda u_, *coords: f_model(u_, tr["vars"], *coords),
+                u, ndim)(X)
+            preds = f_pred if isinstance(f_pred, tuple) else (f_pred,)
             data_loss = MSE(u_pred, u_data)
-            if tr["col_weights"] is not None:
-                res_loss = g_MSE(f_pred, 0.0, tr["col_weights"] ** 2)
-            else:
-                res_loss = MSE(f_pred, 0.0)
-            return data_loss + res_loss, {"Data": data_loss,
-                                          "Residual": res_loss}
+            comps = {"Data": data_loss}
+            res_loss = 0.0
+            for i, p in enumerate(preds):
+                p = p.reshape(-1, 1)
+                if tr["col_weights"] is not None:
+                    term = g_MSE(p, 0.0, tr["col_weights"] ** 2)
+                else:
+                    term = MSE(p, 0.0)
+                comps[f"Residual_{i}" if len(preds) > 1 else "Residual"] = term
+                res_loss = res_loss + term
+            return data_loss + res_loss, comps
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         opt = self.opt

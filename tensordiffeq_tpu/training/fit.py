@@ -158,7 +158,9 @@ def fit_adam(loss_fn: Callable,
     opt = make_optimizer(lr, lr_weights)
     trainables = {"params": params, "lambdas": lambdas}
     opt_state = opt.init(trainables)
-    run = _chunk_runner(loss_fn, opt, n_batches, n_batches * bsz)
+    # classify per-point λ by the UNTRIMMED point count: λ keeps all N_f rows
+    # even when batches drop a remainder, and only gathered rows get gradients
+    run = _chunk_runner(loss_fn, opt, n_batches, N_f)
 
     best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
     total_steps = tf_iter * n_batches

@@ -1,0 +1,58 @@
+"""Validate the generated reference solutions (tensordiffeq_tpu.exact).
+
+The reference ships opaque binary fixtures (AC.mat, burgers_shock.mat);
+here the generators themselves are under test: spectral/quadrature accuracy
+is checked by self-convergence and by the PDE residual in finite differences.
+"""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.exact import (_etdrk4_allen_cahn, allen_cahn_solution,
+                                    burgers_solution)
+
+
+class TestAllenCahn:
+    def test_shapes_and_ic(self):
+        x, t, u = allen_cahn_solution()
+        assert x.shape == (512,) and t.shape == (201,) and u.shape == (512, 201)
+        np.testing.assert_allclose(u[:, 0], x ** 2 * np.cos(np.pi * x))
+        assert np.abs(u).max() <= 1.0 + 1e-6  # AC solutions stay in [-1, 1]
+
+    def test_dt_self_convergence(self):
+        x, u = _etdrk4_allen_cahn(128, 11, 0.1, 1e-4, 0.1 / (10 * 10))
+        x2, u2 = _etdrk4_allen_cahn(128, 11, 0.1, 1e-4, 0.1 / (10 * 20))
+        rel = np.linalg.norm(u - u2) / np.linalg.norm(u2)
+        assert rel < 1e-9
+
+
+class TestBurgers:
+    def test_shapes_ic_and_odd_symmetry(self):
+        x, t, u = burgers_solution()
+        assert u.shape == (256, 100)
+        np.testing.assert_allclose(u[:, 0], -np.sin(np.pi * x), atol=1e-12)
+        # u(-x, t) = -u(x, t): the Cole-Hopf evaluation must preserve this
+        np.testing.assert_allclose(u, -u[::-1, :], atol=1e-8)
+
+    def test_pde_residual_fd(self):
+        x, t, u = burgers_solution()
+        nu = 0.01 / np.pi
+        ut = np.gradient(u, t, axis=1)
+        ux = np.gradient(u, x, axis=0)
+        uxx = np.gradient(ux, x, axis=0)
+        res = ut + u * ux - nu * uxx
+        # away from the shock and the t=0 kink the FD residual is small
+        assert np.median(np.abs(res[50:-50, 20:])) < 5e-4
+
+    def test_quadrature_self_convergence(self):
+        _, _, u1 = burgers_solution(nx=64, nt=20, n_quad=80)
+        _, _, u2 = burgers_solution(nx=64, nt=20, n_quad=120)
+        assert np.linalg.norm(u1 - u2) / np.linalg.norm(u2) < 1e-7
+
+
+def test_cache_roundtrip(tmp_path, monkeypatch):
+    import tensordiffeq_tpu.exact as ex
+    monkeypatch.setattr(ex, "_CACHE_DIR", str(tmp_path))
+    x1, t1, u1 = ex.burgers_solution(nx=32, nt=5, n_quad=40)
+    x2, t2, u2 = ex.burgers_solution(nx=32, nt=5, n_quad=40)  # cached load
+    np.testing.assert_array_equal(u1, u2)

@@ -101,9 +101,15 @@ def test_dist_update_loss_consistent_after_fit(eight_devices):
     assert np.isfinite(float(s.update_loss()[0]))
 
 
-def test_dist_matches_single_device_loss():
-    # the sharded loss is numerically the global full-batch loss
+def test_dist_matches_single_device_loss(eight_devices):
+    # the ACTUALLY-SHARDED loss is numerically the global full-batch loss:
+    # shard X/λ over the 8-device mesh before evaluating (512 % 8 == 0, so
+    # no rows are trimmed and the two computations see identical data)
     s_dist = make_problem()
+    s_dist.X_f, s_dist.lambdas = shard_data_inputs(
+        s_dist.X_f, s_dist.lambdas, mesh=make_mesh())
+    assert s_dist.X_f.sharding.is_equivalent_to(
+        data_sharding(make_mesh(), 2), ndim=2)
     s_single = make_problem()
     s_single.dist = False
     ld, _ = s_dist.update_loss()

@@ -108,6 +108,30 @@ def test_type2_scalar_weights_with_minibatch():
     assert np.isfinite(s.losses[-1]["Total Loss"])
 
 
+def test_sa_minibatch_with_nondividing_batch_size():
+    # regression: per-point λ with batch_sz NOT dividing N_f — λ keeps all
+    # N_f rows while batches tile the trimmed prefix; must gather, not crash
+    domain, bcs, f_model = make_burgers(n_f=256)
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+              dict_adaptive={"residual": [True], "BCs": [False] * 3},
+              init_weights={"residual": [np.ones((256, 1))], "BCs": [None] * 3})
+    s.fit(tf_iter=4, newton_iter=0, batch_sz=100, chunk=2)
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+    assert np.asarray(s.lambdas["residual"][0]).shape == (256, 1)
+
+
+def test_unknown_adaptive_keys_rejected():
+    # regression: a misspelled key must error, not silently disable adaptivity
+    domain, bcs, f_model = make_burgers(n_f=64)
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError, match="unknown key"):
+        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+                  dict_adaptive={"residual": [True], "bcs": [True, False, False]},
+                  init_weights={"residual": [np.ones((64, 1))],
+                                "BCs": [None] * 3})
+
+
 def test_one_dim_weight_vector_normalized():
     # regression: a 1-D (n,) λ must not broadcast into an (n, n) outer product
     from tensordiffeq_tpu.utils import initialize_lambdas

@@ -1,0 +1,31 @@
+"""Shared CLI plumbing for the example scripts.
+
+Every example accepts ``--quick`` (tiny iteration counts + small nets, for
+smoke tests/CI) and ``--plot PATH`` (save figures instead of interactive
+windows).  Full-size defaults reproduce the reference configs recorded in
+``BASELINE.md``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def example_args(description: str, flags=(), **extra):
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny config for smoke testing")
+    ap.add_argument("--plot", default=None, metavar="PATH",
+                    help="save diagnostic plots under this directory")
+    for flag in flags:
+        ap.add_argument(f"--{flag}", action="store_true")
+    for name, (default, help_) in extra.items():
+        ap.add_argument(f"--{name}", type=type(default), default=default,
+                        help=help_)
+    return ap.parse_args()
+
+
+def scaled(args, full: int, quick: int) -> int:
+    return quick if args.quick else full

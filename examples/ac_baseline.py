@@ -1,0 +1,69 @@
+"""Allen-Cahn baseline forward PINN (reference ``examples/AC-baseline.py``).
+
+u_t - 0.0001 u_xx + 5u^3 - 5u = 0 on x in [-1,1], t in [0,1];
+u(x,0) = x^2 cos(pi x), periodic in x (value + first derivative).
+N_f=50k, 2-128x4-1 tanh MLP, 10k Adam + 10k L-BFGS.
+"""
+
+import numpy as np
+
+from _common import example_args, scaled
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
+                              periodicBC)
+from tensordiffeq_tpu.exact import allen_cahn_solution
+
+
+def build_problem(n_f: int, nx: int = 512, nt: int = 201, seed: int = 0):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], nx)
+    domain.add("t", [0.0, 1.0], nt)
+    domain.generate_collocation_points(n_f, seed=seed)
+
+    def func_ic(x):
+        return x ** 2 * np.cos(np.pi * x)
+
+    def deriv_model(u, x, t):
+        return u(x, t), grad(u, "x")(x, t)
+
+    bcs = [IC(domain, [func_ic], var=[["x"]]),
+           periodicBC(domain, ["x"], [deriv_model])]
+
+    def f_model(u, x, t):
+        u_xx = grad(grad(u, "x"), "x")
+        u_t = grad(u, "t")
+        uv = u(x, t)
+        return u_t(x, t) - 0.0001 * u_xx(x, t) + 5.0 * uv ** 3 - 5.0 * uv
+
+    return domain, bcs, f_model
+
+
+def evaluate(solver, args, name):
+    x, t, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = tdq.find_L2_error(u_pred, usol.reshape(-1, 1))
+    print(f"Error u: {err:e}")
+    if args.plot:
+        tdq.plotting.plot_solution_domain1D(
+            solver, [x, t], ub=[1.0, 1.0], lb=[-1.0, 0.0], Exact_u=usol,
+            save_path=f"{args.plot}/{name}.png")
+    return err
+
+
+def main():
+    args = example_args("Allen-Cahn baseline forward PINN")
+    n_f = scaled(args, 50_000, 2_000)
+    domain, bcs, f_model = build_problem(n_f, nx=512 if not args.quick else 64,
+                                         nt=201 if not args.quick else 21)
+    widths = [128] * 4 if not args.quick else [32] * 2
+    solver = CollocationSolverND()
+    solver.compile([2, *widths, 1], f_model, domain, bcs)
+    solver.fit(tf_iter=scaled(args, 10_000, 200),
+               newton_iter=scaled(args, 10_000, 100))
+    return evaluate(solver, args, "ac_baseline")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Allen-Cahn coefficient discovery — inverse problem
+(reference ``examples/AC-discovery.py`` and ``examples/AC-inference.py``,
+which is the same DiscoveryModel workflow under a misleading filename).
+
+Learns c1 (diffusion) and c2 (reaction) in
+``u_t - c1 u_xx + c2 u^3 - c2 u = 0`` from the full 512x201 solution grid,
+optionally with SA collocation weights (``--no-sa`` for the plain variant).
+True values: c1 = 0.0001, c2 = 5.0.
+"""
+
+import numpy as np
+
+from _common import example_args, scaled
+
+from tensordiffeq_tpu import DiscoveryModel, grad
+from tensordiffeq_tpu.exact import allen_cahn_solution
+
+
+def main():
+    args = example_args("Allen-Cahn coefficient discovery", flags=("no-sa",))
+    use_sa = not args.no_sa
+
+    x, t, usol = allen_cahn_solution()
+    if args.quick:
+        x, t, usol = x[::8], t[::8], usol[::8, ::8]
+    X = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_star = usol.reshape(-1, 1)
+
+    def f_model(u, var, x, t):
+        c1, c2 = var
+        u_xx = grad(grad(u, "x"), "x")
+        uv = u(x, t)
+        return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * uv ** 3 - c2 * uv
+
+    rng = np.random.RandomState(0)
+    col_weights = rng.rand(X.shape[0], 1) if use_sa else None
+    widths = [128] * 4 if not args.quick else [32] * 2
+
+    model = DiscoveryModel()
+    model.compile([2, *widths, 1], f_model, [X[:, 0:1], X[:, 1:2]], u_star,
+                  var=[0.0, 0.0], col_weights=col_weights, varnames=["x", "t"])
+    model.fit(tf_iter=scaled(args, 10_000, 300))
+
+    c1, c2 = model.vars
+    print(f"c1 = {float(c1):.6f} (true 0.0001), c2 = {float(c2):.4f} (true 5.0)")
+    return model
+
+
+if __name__ == "__main__":
+    main()

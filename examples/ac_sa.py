@@ -1,0 +1,47 @@
+"""Allen-Cahn Self-Adaptive PINN — the flagship config
+(reference ``examples/AC-SA.py``; SA-PINN, McClenny et al. arXiv:2009.04544).
+
+Same PDE as ``ac_baseline.py`` plus per-point minimax loss weights:
+lambda_residual ~ U[0,1] over the 50k collocation points, lambda_IC ~
+100*U[0,1] over the 512 IC points, trained by gradient ascent while the
+network descends.  (The reference script passes the stale string
+``Adaptive_type='self-adaptive'`` which its own compile() rejects —
+SURVEY §2.4.7; the working encoding is Adaptive_type=1.)
+"""
+
+import numpy as np
+
+from _common import example_args, scaled
+
+from ac_baseline import build_problem, evaluate
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import CollocationSolverND
+
+
+def main():
+    args = example_args("Allen-Cahn Self-Adaptive PINN")
+    n_f = scaled(args, 50_000, 2_000)
+    nx = 512 if not args.quick else 64
+    domain, bcs, f_model = build_problem(n_f, nx=nx,
+                                         nt=201 if not args.quick else 21)
+    widths = [128] * 4 if not args.quick else [32] * 2
+
+    rng = np.random.RandomState(0)
+    dict_adaptive = {"residual": [True], "BCs": [True, False]}
+    init_weights = {"residual": [rng.rand(n_f, 1)],
+                    "BCs": [100.0 * rng.rand(nx, 1), None]}
+
+    solver = CollocationSolverND()
+    solver.compile([2, *widths, 1], f_model, domain, bcs, Adaptive_type=1,
+                   dict_adaptive=dict_adaptive, init_weights=init_weights)
+    solver.fit(tf_iter=scaled(args, 10_000, 200),
+               newton_iter=scaled(args, 10_000, 100))
+    err = evaluate(solver, args, "ac_sa")
+    if args.plot:
+        tdq.plotting.plot_weights(solver, save_path=f"{args.plot}/ac_sa_weights.png")
+    return err
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Forward viscous Burgers PINN (reference ``examples/burgers-new.py``).
+
+u_t + u u_x = (0.01/pi) u_xx on x in [-1,1], t in [0,1];
+u(x,0) = -sin(pi x), u(+-1,t) = 0.  N_f=10k, 2-20x8-1 tanh MLP,
+10k Adam + 10k L-BFGS; validates rel-L2 against the Cole-Hopf solution.
+"""
+
+import numpy as np
+
+from _common import example_args, scaled
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
+                              grad)
+from tensordiffeq_tpu.exact import burgers_solution
+
+
+def main():
+    args = example_args("Burgers shock forward PINN")
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(scaled(args, 10_000, 1_000), seed=0)
+
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        u_xx = grad(u_x, "x")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
+
+    widths = [20] * 8 if not args.quick else [20] * 4
+    solver = CollocationSolverND()
+    solver.compile([2, *widths, 1], f_model, domain, bcs)
+    solver.fit(tf_iter=scaled(args, 10_000, 200),
+               newton_iter=scaled(args, 10_000, 100))
+
+    x, t, usol = burgers_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = tdq.find_L2_error(u_pred, usol.reshape(-1, 1))
+    print(f"Error u: {err:e}")
+
+    if args.plot:
+        tdq.plotting.plot_solution_domain1D(
+            solver, [x, t], ub=[1.0, 1.0], lb=[-1.0, 0.0], Exact_u=usol,
+            save_path=f"{args.plot}/burgers.png")
+    return err
+
+
+if __name__ == "__main__":
+    main()

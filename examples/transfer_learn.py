@@ -1,0 +1,66 @@
+"""Transfer learning / staged-LR resume (reference
+``examples/transfer-learn.py``).
+
+Train Allen-Cahn SA for a first leg, save, then resume twice with lowered
+learning rates.  The reference can only checkpoint the Keras network (λ and
+optimizer state are lost on reload, SURVEY §5); here the full training
+state — params, λ, and Adam moments — round-trips through
+``tensordiffeq_tpu.checkpoint``.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from _common import example_args, scaled
+
+from ac_baseline import build_problem, evaluate
+
+from tensordiffeq_tpu import CollocationSolverND
+
+
+def make_solver(args, n_f, nx, lr):
+    domain, bcs, f_model = build_problem(n_f, nx=nx,
+                                         nt=201 if not args.quick else 21)
+    widths = [128] * 4 if not args.quick else [32] * 2
+    rng = np.random.RandomState(0)
+    solver = CollocationSolverND()
+    solver.compile([2, *widths, 1], f_model, domain, bcs, Adaptive_type=1,
+                   dict_adaptive={"residual": [True], "BCs": [True, False]},
+                   init_weights={"residual": [rng.rand(n_f, 1)],
+                                 "BCs": [100.0 * rng.rand(nx, 1), None]},
+                   lr=lr, lr_weights=lr)
+    return solver
+
+
+def main():
+    args = example_args("Transfer learning with staged learning rates")
+    n_f = scaled(args, 50_000, 2_000)
+    nx = 512 if not args.quick else 64
+    leg = scaled(args, 5_000, 100)
+
+    ckpt_dir = os.path.join(tempfile.mkdtemp(), "ac_ckpt")
+
+    solver = make_solver(args, n_f, nx, lr=0.005)
+    solver.fit(tf_iter=leg)
+    solver.save_checkpoint(ckpt_dir)
+    print(f"leg 1 done, loss {solver.losses[-1]['Total Loss']:.4e}")
+
+    # resume with 10x lower LR: fresh solver object, restore full state
+    solver = make_solver(args, n_f, nx, lr=0.0005)
+    solver.restore_checkpoint(ckpt_dir)
+    solver.fit(tf_iter=leg)
+    solver.save_checkpoint(ckpt_dir)
+    print(f"leg 2 done, loss {solver.losses[-1]['Total Loss']:.4e}")
+
+    solver = make_solver(args, n_f, nx, lr=0.00005)
+    solver.restore_checkpoint(ckpt_dir)
+    solver.fit(tf_iter=leg)
+    print(f"leg 3 done, loss {solver.losses[-1]['Total Loss']:.4e}")
+
+    return evaluate(solver, args, "transfer_learn")
+
+
+if __name__ == "__main__":
+    main()

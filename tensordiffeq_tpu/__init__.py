@@ -28,7 +28,8 @@ Quick start (Burgers)::
     solver.fit(tf_iter=10_000, newton_iter=10_000)
 """
 
-from . import boundaries, domains, helpers, networks, ops, output  # noqa: F401
+from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
+from . import networks, ops, output  # noqa: F401
 from . import parallel, plotting, sampling, training, utils  # noqa: F401
 from . import models  # noqa: F401
 from .boundaries import (  # noqa: F401

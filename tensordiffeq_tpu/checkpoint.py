@@ -1,0 +1,93 @@
+"""Full training-state checkpoints: params + SA λ + optimizer moments.
+
+The reference can only persist the Keras network (``models.py:315-319``) —
+its λ weights and Adam/L-BFGS state are silently lost on reload (SURVEY §5),
+so "resume" actually restarts the minimax from scratch.  Here the complete
+trainable state round-trips:
+
+* primary path: `orbax.checkpoint` ``StandardCheckpointer`` (async-capable,
+  sharding-aware — the right tool once states are sharded over a mesh);
+* fallback: `flax.serialization` msgpack bytes in a single file (used when
+  orbax is unavailable or the state contains objects orbax rejects).
+
+Both are behind the same two functions, keyed by a directory path::
+
+    save_checkpoint(path, state)
+    state = restore_checkpoint(path, template)   # template supplies structure
+
+``template`` must be a pytree with the same structure/shapes as the saved
+state (build it from a freshly compiled solver, as
+``CollocationSolverND.restore_checkpoint`` does).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+_META = "tdq_meta.json"
+_FLAX_FILE = "state.msgpack"
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
+    """Write ``state`` (a pytree dict) under directory ``path``.
+
+    ``meta`` is an optional JSON-serialisable dict stored alongside (losses
+    history, iteration counters, …).
+    """
+    os.makedirs(path, exist_ok=True)
+    state = _to_host(state)
+    backend = "flax"
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        target = os.path.join(os.path.abspath(path), "state")
+        # orbax refuses to overwrite; emulate standard resume semantics
+        if os.path.exists(target):
+            import shutil
+            shutil.rmtree(target)
+        ckptr.save(target, state)
+        ckptr.wait_until_finished()
+        backend = "orbax"
+    except Exception:
+        import flax.serialization
+        with open(os.path.join(path, _FLAX_FILE), "wb") as fh:
+            fh.write(flax.serialization.to_bytes(state))
+    with open(os.path.join(path, _META), "w") as fh:
+        json.dump({"backend": backend, "meta": meta or {}}, fh)
+
+
+def restore_checkpoint(path: str, template: dict) -> tuple[dict, dict]:
+    """Load the state saved under ``path``.  ``template`` provides the pytree
+    structure (and, for the orbax path, shape/dtype guidance).  Returns
+    ``(state, meta)``."""
+    with open(os.path.join(path, _META)) as fh:
+        info = json.load(fh)
+    if info["backend"] == "orbax":
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        state = ckptr.restore(os.path.join(os.path.abspath(path), "state"),
+                              _to_host(template))
+    else:
+        import flax.serialization
+        with open(os.path.join(path, _FLAX_FILE), "rb") as fh:
+            state = flax.serialization.from_bytes(template, fh.read())
+    # orbax will happily hand back whatever shapes were saved — validate
+    # against the template so a wrong-config restore fails loudly here
+    t_leaves = jax.tree_util.tree_leaves(_to_host(template))
+    s_leaves = jax.tree_util.tree_leaves(state)
+    for t, s in zip(t_leaves, s_leaves):
+        if tuple(np.shape(t)) != tuple(np.shape(s)):
+            raise ValueError(
+                f"checkpoint leaf shape {np.shape(s)} does not match the "
+                f"template's {np.shape(t)}; was this checkpoint saved for a "
+                "different configuration?")
+    return state, info["meta"]

@@ -33,7 +33,8 @@ from ..domains import DomainND
 from ..networks import neural_net
 from ..ops.derivatives import make_ufn, vmap_residual
 from ..output import print_screen
-from ..training.fit import FitResult, fit_adam
+from ..training.fit import (FitResult, fit_adam, make_optimizer,
+                            opt_state_matches)
 from ..utils import initialize_lambdas, tree_copy
 from .assembly import build_loss_fn
 
@@ -59,6 +60,7 @@ class CollocationSolverND:
         self.best_model = {"adam": None, "l-bfgs": None, "overall": None}
         self.data_X = None
         self.data_s = None
+        self.opt_state = None  # Adam moments; persists across fit() calls
         self._compiled = False
 
     # ------------------------------------------------------------------ #
@@ -236,11 +238,19 @@ class CollocationSolverND:
         result = FitResult()
         result.losses = self.losses
         if tf_iter > 0:
-            trainables, _, result = fit_adam(
+            if self.opt_state is not None and not opt_state_matches(
+                    make_optimizer(self.lr, self.lr_weights),
+                    {"params": self.params, "lambdas": lambdas},
+                    self.opt_state):
+                # solver-managed state can go stale (e.g. λ rows trimmed by
+                # dist sharding); restart the moments rather than erroring
+                self.opt_state = None
+            trainables, self.opt_state, result = fit_adam(
                 self.loss_fn, self.params, lambdas, X_f,
                 tf_iter=tf_iter, batch_sz=batch_sz, lr=self.lr,
                 lr_weights=self.lr_weights, chunk=chunk,
-                verbose=self.verbose, result=result)
+                verbose=self.verbose, result=result,
+                opt_state=self.opt_state)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
             self.best_model["adam"] = result.best_params["adam"]
@@ -285,6 +295,49 @@ class CollocationSolverND:
         else:
             f_np = np.asarray(f_star)
         return np.asarray(u_star), f_np
+
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, path: str):
+        """Checkpoint the FULL training state — params, SA λ, Adam moments,
+        loss history — under directory ``path`` (what the reference cannot
+        do: its save/load drops λ and optimizer state, SURVEY §5)."""
+        from ..checkpoint import save_checkpoint
+        state = {"params": self.params, "lambdas": self.lambdas}
+        if self.opt_state is not None:
+            state["opt_state"] = self.opt_state
+        meta = {"losses": self.losses,
+                "min_loss": {k: float(v) for k, v in self.min_loss.items()},
+                "best_epoch": dict(self.best_epoch),
+                "has_opt_state": self.opt_state is not None}
+        save_checkpoint(path, state, meta)
+
+    def restore_checkpoint(self, path: str):
+        """Restore a :meth:`save_checkpoint` state into this (compiled)
+        solver.  The solver must be compiled with the same configuration so
+        the state template matches."""
+        if not self._compiled:
+            raise RuntimeError("Call compile(...) before restore_checkpoint")
+        from ..checkpoint import restore_checkpoint
+        template = {"params": self.params, "lambdas": self.lambdas}
+        # peek at meta to know whether optimizer moments were saved
+        import json as _json
+        import os as _os
+        with open(_os.path.join(path, "tdq_meta.json")) as fh:
+            has_opt = _json.load(fh)["meta"].get("has_opt_state", False)
+        if has_opt:
+            opt = make_optimizer(self.lr, self.lr_weights)
+            template["opt_state"] = opt.init(
+                {"params": self.params, "lambdas": self.lambdas})
+        state, meta = restore_checkpoint(path, template)
+        self.params = state["params"]
+        self.lambdas = state["lambdas"]
+        self.opt_state = state.get("opt_state")
+        self.losses = list(meta.get("losses", []))
+        for k, v in meta.get("min_loss", {}).items():
+            self.min_loss[k] = float(v)
+        for k, v in meta.get("best_epoch", {}).items():
+            self.best_epoch[k] = int(v)
+        return self
 
     # ------------------------------------------------------------------ #
     def save(self, path: str):

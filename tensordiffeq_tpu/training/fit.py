@@ -51,6 +51,19 @@ def make_optimizer(lr: float = 0.005, lr_weights: float = 0.005,
         label_fn)
 
 
+def opt_state_matches(opt, trainables, opt_state) -> bool:
+    """True iff ``opt_state`` has the structure and leaf shapes that
+    ``opt.init(trainables)`` would produce — a resumed state must match or
+    the mismatch surfaces as an opaque error deep inside jit."""
+    want = jax.eval_shape(opt.init, trainables)
+    if (jax.tree_util.tree_structure(want)
+            != jax.tree_util.tree_structure(opt_state)):
+        return False
+    return all(tuple(np.shape(a)) == tuple(w.shape)
+               for a, w in zip(jax.tree_util.tree_leaves(opt_state),
+                               jax.tree_util.tree_leaves(want)))
+
+
 @dataclass
 class FitResult:
     """Host-side training record (parity with the reference's ``self.losses``
@@ -138,6 +151,7 @@ def fit_adam(loss_fn: Callable,
              chunk: int = 100,
              verbose: bool = True,
              result: Optional[FitResult] = None,
+             opt_state: Any = None,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -157,7 +171,13 @@ def fit_adam(loss_fn: Callable,
 
     opt = make_optimizer(lr, lr_weights)
     trainables = {"params": params, "lambdas": lambdas}
-    opt_state = opt.init(trainables)
+    if opt_state is None:
+        opt_state = opt.init(trainables)
+    elif not opt_state_matches(opt, trainables, opt_state):
+        raise ValueError(
+            "opt_state does not match the current trainables (structure or "
+            "shapes differ); was the checkpoint saved for a different "
+            "configuration?")
     # classify per-point λ by the UNTRIMMED point count: λ keeps all N_f rows
     # even when batches drop a remainder, and only gathered rows get gradients
     run = _chunk_runner(loss_fn, opt, n_batches, N_f)

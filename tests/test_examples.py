@@ -1,0 +1,38 @@
+"""Example scripts stay runnable (API-drift guard).
+
+Runs a subset of examples in-process with ``--quick`` — the de-facto
+integration-test role the reference's examples played (SURVEY §4), but
+actually wired into CI.  The heavier scripts are exercised manually /
+by the benchmark harness.
+"""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+
+
+def run_example(name, *argv):
+    old_argv, old_path = sys.argv, list(sys.path)
+    sys.argv = [name, "--quick", *argv]
+    sys.path.insert(0, EXAMPLES)
+    try:
+        return runpy.run_path(os.path.join(EXAMPLES, name),
+                              run_name="__main__")
+    finally:
+        sys.argv, sys.path = old_argv, old_path
+
+
+def test_poisson_example_runs():
+    run_example("steady_state_poisson.py")
+
+
+def test_discovery_example_runs():
+    run_example("ac_discovery.py", "--no-sa")
+
+
+def test_checkpoint_transfer_example_runs(tmp_path):
+    run_example("transfer_learn.py")

@@ -125,12 +125,18 @@ def build_loss_fn(apply_fn: Callable,
                     los = _vmap_deriv(dfn, u, lower_pts)
                     for up, lo in zip(ups, los):
                         loss_bc += MSE(up, lo)
+                # scalar term weight (NTK weighting reaches periodic BCs;
+                # user-provided per-point λ is rejected upstream)
+                if lam is not None and weight_outside_sum:
+                    loss_bc = jnp.reshape(lam, ()) * loss_bc
             else:  # neumann — derivative on each var's face vs its own target
                 loss_bc = 0.0
                 for inp_pts, val_i, dfn in zip(a, b, derivs):
                     vals = _vmap_deriv(dfn, u, inp_pts)
                     for comp in vals:
                         loss_bc += MSE(val_i, comp.reshape(val_i.shape))
+                if lam is not None and weight_outside_sum:
+                    loss_bc = jnp.reshape(lam, ()) * loss_bc
             components[f"BC_{i}"] = loss_bc
             loss_bcs = loss_bcs + loss_bc
 

@@ -45,8 +45,9 @@ class CollocationSolverND:
     Reference parity: ``models.py:12-322``.  ``Adaptive_type`` keeps the
     reference's encoding (``models.py:35-39``): 0 = baseline, 1 =
     self-adaptive per-point (SA-PINN), 2 = self-adaptive scalar per-loss,
-    3 = NTK (declared but unimplemented in the reference; rejected here with
-    a clear error instead of silently degrading).
+    3 = NTK balancing (declared but dead code in the reference,
+    ``models.py:76-84``; actually implemented here —
+    :mod:`tensordiffeq_tpu.ops.ntk`).
     """
 
     def __init__(self, assimilate: bool = False, verbose: bool = True,
@@ -108,14 +109,16 @@ class CollocationSolverND:
         # -- adaptive configuration (reference models.py:68-105) ----------
         if Adaptive_type not in (0, 1, 2, 3):
             raise ValueError("Adaptive method invalid! (expected 0, 1, 2 or 3)")
-        if Adaptive_type == 3:
-            raise NotImplementedError(
-                "NTK weighting (type 3) is declared but not implemented in "
-                "the reference (models.py:76-84); not supported yet")
         self.Adaptive_type = Adaptive_type
         self.isAdaptive = Adaptive_type in (1, 2)
-        self.weight_outside_sum = Adaptive_type == 2
+        self.use_ntk = Adaptive_type == 3
+        self.weight_outside_sum = Adaptive_type in (2, 3)
         self.dict_adaptive = dict_adaptive
+        if self.use_ntk and (dict_adaptive is not None
+                             or init_weights is not None):
+            raise ValueError(
+                "NTK weighting (type 3) computes all term weights from the "
+                "tangent kernel; dict_adaptive/init_weights must be None")
 
         if self.isAdaptive:
             if dict_adaptive is None or init_weights is None:
@@ -162,8 +165,25 @@ class CollocationSolverND:
             self.lambdas = {"residual": [], "BCs": []}
 
         self.X_f = jnp.asarray(domain.X_f, jnp.float32)
+        if self.use_ntk:
+            # one scalar weight per loss term, starting balanced at 1;
+            # refreshed from NTK traces between training chunks
+            n_res = self._count_residuals()
+            self.lambdas = {
+                "residual": [jnp.ones((), jnp.float32)] * n_res,
+                "BCs": [jnp.ones((), jnp.float32)] * len(self.bcs)}
         self._build()
         self._compiled = True
+
+    def _count_residuals(self) -> int:
+        """Number of residual components ``f_model`` returns (trace once on
+        a single point; multi-equation systems return a tuple)."""
+        from ..ops.derivatives import make_ufn
+        u = make_ufn(self.apply_fn, self.params, self.domain.vars, self.n_out)
+        out = jax.eval_shape(
+            lambda pt: self.f_model(u, *(pt[i] for i in range(self.domain.ndim))),
+            jax.ShapeDtypeStruct((self.domain.ndim,), jnp.float32))
+        return len(out) if isinstance(out, tuple) else 1
 
     def _build(self):
         self.loss_fn = build_loss_fn(
@@ -179,6 +199,14 @@ class CollocationSolverND:
 
         self._residual_jit = jax.jit(residual)
         self._apply_jit = jax.jit(self.apply_fn)
+
+        self._ntk_fn = None
+        if getattr(self, "use_ntk", False):
+            from ..ops.ntk import build_error_fns, make_ntk_weight_fn
+            bc_fns, res_fns, _ = build_error_fns(
+                self.apply_fn, self.domain.vars, self.n_out, self.f_model,
+                self.bcs, self.X_f, n_residuals=len(self.lambdas["residual"]))
+            self._ntk_fn = make_ntk_weight_fn(bc_fns, res_fns)
 
     # ------------------------------------------------------------------ #
     def compile_data(self, x, t, y):
@@ -238,8 +266,10 @@ class CollocationSolverND:
         result = FitResult()
         result.losses = self.losses
         if tf_iter > 0:
+            freeze = getattr(self, "use_ntk", False)
             if self.opt_state is not None and not opt_state_matches(
-                    make_optimizer(self.lr, self.lr_weights),
+                    make_optimizer(self.lr, self.lr_weights,
+                                   freeze_lambdas=freeze),
                     {"params": self.params, "lambdas": lambdas},
                     self.opt_state):
                 # solver-managed state can go stale (e.g. λ rows trimmed by
@@ -250,7 +280,8 @@ class CollocationSolverND:
                 tf_iter=tf_iter, batch_sz=batch_sz, lr=self.lr,
                 lr_weights=self.lr_weights, chunk=chunk,
                 verbose=self.verbose, result=result,
-                opt_state=self.opt_state)
+                opt_state=self.opt_state, freeze_lambdas=freeze,
+                lambda_update_fn=self._ntk_fn)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
             self.best_model["adam"] = result.best_params["adam"]

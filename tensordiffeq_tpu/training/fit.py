@@ -35,9 +35,13 @@ from .progress import progress_bar
 
 
 def make_optimizer(lr: float = 0.005, lr_weights: float = 0.005,
-                   b1: float = 0.99) -> optax.GradientTransformation:
+                   b1: float = 0.99, freeze_lambdas: bool = False
+                   ) -> optax.GradientTransformation:
     """Adam for the network + Adam-ascent for λ (reference defaults
-    ``lr=0.005, beta_1=0.99``, ``models.py:49-50``), as one transform."""
+    ``lr=0.005, beta_1=0.99``, ``models.py:49-50``), as one transform.
+
+    ``freeze_lambdas=True`` pins λ inside the scan (used by NTK weighting,
+    where λ are recomputed analytically between chunks, not trained)."""
 
     def label_fn(trainables):
         return {
@@ -45,10 +49,10 @@ def make_optimizer(lr: float = 0.005, lr_weights: float = 0.005,
             "lambdas": jax.tree_util.tree_map(lambda _: "lam", trainables["lambdas"]),
         }
 
-    return optax.multi_transform(
-        {"net": optax.adam(lr, b1=b1),
-         "lam": optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=b1))},
-        label_fn)
+    lam_tx = (optax.set_to_zero() if freeze_lambdas
+              else optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=b1)))
+    return optax.multi_transform({"net": optax.adam(lr, b1=b1), "lam": lam_tx},
+                                 label_fn)
 
 
 def opt_state_matches(opt, trainables, opt_state) -> bool:
@@ -152,6 +156,8 @@ def fit_adam(loss_fn: Callable,
              verbose: bool = True,
              result: Optional[FitResult] = None,
              opt_state: Any = None,
+             freeze_lambdas: bool = False,
+             lambda_update_fn: Optional[Callable] = None,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -169,8 +175,10 @@ def fit_adam(loss_fn: Callable,
     X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
     idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
 
-    opt = make_optimizer(lr, lr_weights)
+    opt = make_optimizer(lr, lr_weights, freeze_lambdas=freeze_lambdas)
     trainables = {"params": params, "lambdas": lambdas}
+    if lambda_update_fn is not None:  # e.g. NTK: balance before step 0
+        trainables["lambdas"] = lambda_update_fn(trainables["params"])
     if opt_state is None:
         opt_state = opt.init(trainables)
     elif not opt_state_matches(opt, trainables, opt_state):
@@ -198,6 +206,8 @@ def fit_adam(loss_fn: Callable,
             i = (e + 1) * n_batches - 1
             result.losses.append({k: float(v[i]) for k, v in comps.items()})
         steps_done += n
+        if lambda_update_fn is not None and steps_done < total_steps:
+            trainables["lambdas"] = lambda_update_fn(trainables["params"])
         if pbar is not None:
             pbar.update(n // n_batches)
             pbar.set_postfix(loss=result.losses[-1]["Total Loss"])

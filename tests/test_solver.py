@@ -164,8 +164,12 @@ def test_sa_validation_errors():
     with pytest.raises(ValueError):
         s.compile([2, 8, 1], f_model, domain, bcs,
                   dict_adaptive={"residual": [True], "BCs": [False] * 3})
-    with pytest.raises(NotImplementedError):
-        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=3)
+    with pytest.raises(ValueError, match="tangent kernel"):
+        # NTK mode manages its own weights; explicit ones are rejected
+        s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=3,
+                  dict_adaptive={"residual": [True], "BCs": [False] * 3},
+                  init_weights={"residual": [np.ones((64, 1))],
+                                "BCs": [None] * 3})
 
 
 def test_adaptive_periodic_rejected():

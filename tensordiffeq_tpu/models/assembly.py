@@ -76,9 +76,11 @@ def build_loss_fn(apply_fn: Callable,
       data_X / data_s: optional assimilation observations.
 
     Returns a pure function
-    ``loss(params, lam_bcs, lam_res, X_batch) -> (total, components)`` where
-    ``lam_bcs``/``lam_res`` are per-term lists (``None`` = non-adaptive) and
-    ``components`` is the reference's per-epoch loss dict
+    ``loss(params, lam_bcs, lam_res, X_batch, lam_data=None) ->
+    (total, components)`` where ``lam_bcs``/``lam_res`` are per-term lists
+    (``None`` = non-adaptive), ``lam_data`` is an optional scalar weight on
+    the assimilation term (NTK balancing), and ``components`` is the
+    reference's per-epoch loss dict
     (``BC_i`` / ``Residual_i`` / ``Total Loss``, ``models.py:117-216``).
     """
     ndim = len(varnames)
@@ -108,7 +110,7 @@ def build_loss_fn(apply_fn: Callable,
         data_X = jnp.asarray(data_X, jnp.float32)
         data_s = jnp.asarray(data_s, jnp.float32)
 
-    def loss(params, lam_bcs, lam_res, X_batch):
+    def loss(params, lam_bcs, lam_res, X_batch, lam_data=None):
         u = make_ufn(apply_fn, params, varnames, n_out)
         components: dict[str, jnp.ndarray] = {}
 
@@ -159,6 +161,8 @@ def build_loss_fn(apply_fn: Callable,
 
         if data_X is not None:
             loss_data = MSE(apply_fn(params, data_X), data_s)
+            if lam_data is not None:  # scalar NTK balancing weight
+                loss_data = jnp.reshape(lam_data, ()) * loss_data
             components["Data"] = loss_data
             total = total + loss_data
 

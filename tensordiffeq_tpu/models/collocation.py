@@ -203,10 +203,15 @@ class CollocationSolverND:
         self._ntk_fn = None
         if getattr(self, "use_ntk", False):
             from ..ops.ntk import build_error_fns, make_ntk_weight_fn
-            bc_fns, res_fns, _ = build_error_fns(
+            n_res = len(self.lambdas["residual"])
+            bc_fns, res_all_fn, data_fn = build_error_fns(
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
-                self.bcs, self.X_f, n_residuals=len(self.lambdas["residual"]))
-            self._ntk_fn = make_ntk_weight_fn(bc_fns, res_fns)
+                self.bcs, self.X_f, n_residuals=n_res,
+                data_X=self.data_X, data_s=self.data_s)
+            self._ntk_fn = make_ntk_weight_fn(bc_fns, res_all_fn, n_res,
+                                              data_fn=data_fn)
+            if data_fn is not None and "data" not in self.lambdas:
+                self.lambdas["data"] = [jnp.ones((), jnp.float32)]
 
     # ------------------------------------------------------------------ #
     def compile_data(self, x, t, y):
@@ -239,8 +244,9 @@ class CollocationSolverND:
     def update_loss(self):
         """Current composite loss and components on the full collocation set
         (debug/inspection parity with reference ``models.py:116-218``)."""
-        total, comps = self.loss_fn(self.params, self.lambdas["BCs"],
-                                    self.lambdas["residual"], self.X_f)
+        total, comps = self.loss_fn(
+            self.params, self.lambdas["BCs"], self.lambdas["residual"],
+            self.X_f, lam_data=self.lambdas.get("data", (None,))[0])
         return total, comps
 
     # ------------------------------------------------------------------ #
@@ -356,7 +362,8 @@ class CollocationSolverND:
         with open(_os.path.join(path, "tdq_meta.json")) as fh:
             has_opt = _json.load(fh)["meta"].get("has_opt_state", False)
         if has_opt:
-            opt = make_optimizer(self.lr, self.lr_weights)
+            opt = make_optimizer(self.lr, self.lr_weights,
+                                 freeze_lambdas=getattr(self, "use_ntk", False))
             template["opt_state"] = opt.init(
                 {"params": self.params, "lambdas": self.lambdas})
         state, meta = restore_checkpoint(path, template)

@@ -1,0 +1,113 @@
+"""Native (C++) host-side runtime components, bound via ctypes.
+
+The TPU compute path is JAX/XLA; host-side setup work that is scalar-loop
+heavy lives here instead.  Currently: the ESE maximin-LHS annealing
+optimizer (see ``ese.cpp``), replacing the reference's vendored-SMT Python
+implementation (reference ``sampling.py:315-534``) with a compiled one.
+
+The shared library is built lazily with ``g++`` on first use and cached
+next to the source (keyed on source mtime).  Everything degrades
+gracefully: if no toolchain is available, callers fall back to the pure
+NumPy implementation in :mod:`tensordiffeq_tpu.sampling`.  Set
+``TDQ_NO_NATIVE=1`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ese.cpp")
+_LIB = os.path.join(_DIR, "_ese.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> None:
+    # compile to a process-unique temp path, then atomically rename: two
+    # processes racing the first build must never interleave writes into
+    # the cached .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def load():
+    """Return the loaded ctypes library, building it if needed, or ``None``
+    when native support is unavailable (no compiler, build error, opt-out)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("TDQ_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if stale:
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            lib.tdq_phi_p.restype = ctypes.c_double
+            lib.tdq_phi_p.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+                ctypes.c_double]
+            lib.tdq_ese_optimize.restype = ctypes.c_double
+            lib.tdq_ese_optimize.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+                ctypes.c_double, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_uint64]
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            _load_failed = True
+            print(f"[tdq.native] C++ ESE unavailable ({e}); "
+                  "using NumPy fallback")
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def phi_p(X: np.ndarray, p: float = 10.0) -> float:
+    """PhiP space-filling criterion via the native kernel."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    return lib.tdq_phi_p(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        X.shape[0], X.shape[1], p)
+
+
+def ese_optimize(X: np.ndarray, p: float = 10.0,
+                 outer_loops: int = 30, inner_loops: int = 20, J: int = 10,
+                 seed: int = 0) -> np.ndarray:
+    """ESE maximin optimization of a unit-cube LHS design (copy returned).
+
+    Mirrors :func:`tensordiffeq_tpu.sampling._maximin_ese`'s algorithm; see
+    ``ese.cpp`` for the annealing details.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = np.ascontiguousarray(X, dtype=np.float64).copy()
+    lib.tdq_ese_optimize(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.shape[0], out.shape[1], p, outer_loops, inner_loops, J,
+        np.uint64(seed))
+    return out

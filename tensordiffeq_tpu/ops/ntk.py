@@ -102,15 +102,14 @@ def build_error_fns(apply_fn: Callable, varnames: Sequence[str], n_out: int,
 
     X_sub = _subsample(jnp.asarray(X_f, jnp.float32), max_points)
 
-    res_fns = []
-    for j in range(n_residuals):
-        def e_res(params, j=j):
-            u = make_ufn(apply_fn, params, varnames, n_out)
-            out = vmap_residual(f_model, u, ndim)(X_sub)
-            out = out if isinstance(out, tuple) else (out,)
-            return out[j].ravel()
-
-        res_fns.append(e_res)
+    def res_all_fn(params):
+        """All residual components stacked as ``[n_residuals, m]`` — one
+        forward + one Jacobian pass covers every equation of a system."""
+        u = make_ufn(apply_fn, params, varnames, n_out)
+        out = vmap_residual(f_model, u, ndim)(X_sub)
+        out = out if isinstance(out, tuple) else (out,)
+        assert len(out) == n_residuals, (len(out), n_residuals)
+        return jnp.stack([o.ravel() for o in out])
 
     data_fn = None
     if data_X is not None:
@@ -120,7 +119,7 @@ def build_error_fns(apply_fn: Callable, varnames: Sequence[str], n_out: int,
         def data_fn(params):
             return (apply_fn(params, dX) - ds).ravel()
 
-    return bc_fns, res_fns, data_fn
+    return bc_fns, res_all_fn, data_fn
 
 
 def trace_K(e_fn: Callable, params) -> jnp.ndarray:
@@ -130,24 +129,33 @@ def trace_K(e_fn: Callable, params) -> jnp.ndarray:
                for leaf in jax.tree_util.tree_leaves(J))
 
 
-def make_ntk_weight_fn(bc_fns, res_fns, data_fn=None,
+def make_ntk_weight_fn(bc_fns, res_all_fn, n_residuals: int, data_fn=None,
                        eps: float = 1e-12) -> Callable:
     """Build the jitted weight-update function
-    ``ntk_weights(params) -> {"BCs": [...], "residual": [...]}``
-    with each weight a 0-d scalar array λ_i = Σ tr K / tr K_i."""
+    ``ntk_weights(params) -> {"BCs": [...], "residual": [...][, "data": [...]]}``
+    with each weight a 0-d scalar array λ_i = Σ tr K / tr K_i, matching the
+    lambdas pytree the solver trains (the optional ``"data"`` entry weights
+    the assimilation term)."""
 
     @jax.jit
     def ntk_weights(params):
-        traces = ([trace_K(f, params) for f in bc_fns]
-                  + [trace_K(f, params) for f in res_fns]
-                  + ([trace_K(data_fn, params)] if data_fn else []))
+        bc_traces = [trace_K(f, params) for f in bc_fns]
+        # one Jacobian of the stacked [n_res, m] residual matrix; per-row
+        # Frobenius norms give every equation's trace in a single pass
+        J = jax.jacrev(res_all_fn)(params)
+        res_traces_vec = sum(
+            jnp.sum(jnp.square(leaf), axis=tuple(range(1, leaf.ndim)))
+            for leaf in jax.tree_util.tree_leaves(J))
+        res_traces = [res_traces_vec[j] for j in range(n_residuals)]
+        data_traces = [trace_K(data_fn, params)] if data_fn else []
+        traces = bc_traces + res_traces + data_traces
         total = sum(traces)
         lam = [(total / (t + eps)).reshape(()) for t in traces]
         n_bc = len(bc_fns)
         out = {"BCs": lam[:n_bc],
-               "residual": lam[n_bc:n_bc + len(res_fns)]}
+               "residual": lam[n_bc:n_bc + n_residuals]}
         if data_fn:
-            out["data"] = lam[-1]
+            out["data"] = [lam[-1]]
         return out
 
     return ntk_weights

@@ -167,8 +167,25 @@ class LHS(ScaledSamplingMethod):
             return best
         if crit == "ese":
             sampler = qmc.LatinHypercube(d=nx, scramble=True, seed=seed)
-            return _maximin_ese(sampler.random(nt), rng)
+            X0 = sampler.random(nt)
+            if nt >= 3:
+                from . import native
+                if native.available():
+                    outer, inner, J = _ese_schedule(*X0.shape)
+                    return native.ese_optimize(
+                        X0, outer_loops=outer, inner_loops=inner, J=J,
+                        seed=seed)
+            return _maximin_ese(X0, rng)
         raise ValueError(f"Unknown LHS criterion: {crit!r}")
+
+
+def _ese_schedule(n: int, nx: int) -> tuple:
+    """Annealing schedule (outer loops, inner loops, J proposals) shared by
+    the NumPy and native C++ ESE implementations."""
+    outer = min(30, max(5, int(1.5 * nx)))
+    inner = min(20, max(5, n // 5))
+    J = min(10, max(1, n // 10))
+    return outer, inner, J
 
 
 def _phi_p(X: np.ndarray, p: float = 10.0) -> float:
@@ -216,9 +233,9 @@ def _maximin_ese(X: np.ndarray, rng: np.random.RandomState, p: float = 10.0,
     n, nx = X.shape
     if n < 3:
         return X
-    outer_loops = outer_loops or min(30, max(5, int(1.5 * nx)))
-    inner_loops = inner_loops or min(20, max(5, n // 5))
-    J = min(10, max(1, n // 10))  # candidate swaps per proposal
+    default_outer, default_inner, J = _ese_schedule(n, nx)
+    outer_loops = outer_loops or default_outer
+    inner_loops = inner_loops or default_inner
 
     X = X.copy()
     phi = _phi_p(X, p)

@@ -110,7 +110,9 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
             # (type-2) λ apply to the whole term and pass through untouched
             lam_res = [lam[idx_b] if _is_per_point(lam) else lam
                        for lam in lambdas["residual"]]
-        return loss_fn(trainables["params"], lambdas["BCs"], lam_res, X_b)
+        lam_data = lambdas.get("data", (None,))[0]
+        return loss_fn(trainables["params"], lambdas["BCs"], lam_res, X_b,
+                       lam_data=lam_data)
 
     grad_fn = jax.value_and_grad(loss_over_trainables, has_aux=True)
 

@@ -119,9 +119,10 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
     with ``loss_dicts`` shaped like the Adam history entries."""
     lam_bcs = lambdas["BCs"]
     lam_res = lambdas["residual"]
+    lam_data = lambdas.get("data", (None,))[0]
 
     def fun(p):
-        return loss_fn(p, lam_bcs, lam_res, X_f)[0]
+        return loss_fn(p, lam_bcs, lam_res, X_f, lam_data=lam_data)[0]
 
     t0 = time.time()
     x, x_best, f_best, i_best, history = lbfgs_minimize(

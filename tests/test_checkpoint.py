@@ -97,3 +97,34 @@ def test_raw_api_roundtrip(tmp_path):
     out, meta = restore_checkpoint(str(tmp_path / "raw"), state)
     np.testing.assert_array_equal(out["a"], state["a"])
     assert meta["note"] == "hi"
+
+
+def make_ntk_solver(n_f=128):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(n_f, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=3)
+    return s
+
+
+def test_ntk_checkpoint_roundtrip(tmp_path):
+    # Regression: the restore template must build its opt_state with
+    # freeze_lambdas=True for NTK solvers, else the pytree structures differ
+    s = make_ntk_solver()
+    s.fit(tf_iter=10, newton_iter=0, chunk=5)
+    s.save_checkpoint(str(tmp_path / "ck"))
+
+    s2 = make_ntk_solver()
+    s2.restore_checkpoint(str(tmp_path / "ck"))
+    for l1, l2 in zip(jax_leaves(s.params), jax_leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    # resumed state is directly trainable
+    s2.fit(tf_iter=5, newton_iter=0, chunk=5)
+    assert np.isfinite(float(s2.losses[-1]["Total Loss"]))

@@ -1,0 +1,69 @@
+"""Native C++ ESE sampler tests: build, parity with the NumPy fallback's
+contract (LHS-preserving swaps, PhiP improvement), determinism, and the
+sampler integration path."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import native
+from tensordiffeq_tpu.sampling import LHS, _phi_p
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="C++ toolchain unavailable")
+
+
+def test_phi_p_matches_numpy():
+    rng = np.random.RandomState(0)
+    X = rng.rand(50, 3)
+    assert native.phi_p(X) == pytest.approx(_phi_p(X), rel=1e-10)
+
+
+def test_ese_improves_phi_p():
+    rng = np.random.RandomState(1)
+    X = rng.rand(60, 2)
+    X_opt = native.ese_optimize(X, seed=7)
+    assert native.phi_p(X_opt) <= native.phi_p(X) + 1e-12
+
+
+def test_ese_preserves_lhs_property():
+    # Column-wise row swaps must keep each column a permutation of itself.
+    n = 48
+    X = LHS(xlimits=np.array([[0.0, 1.0], [0.0, 1.0]]), random_state=2)(n)
+    X_opt = native.ese_optimize(X, seed=3)
+    for k in range(X.shape[1]):
+        np.testing.assert_allclose(
+            np.sort(X_opt[:, k]), np.sort(X[:, k]), rtol=0, atol=0)
+
+
+def test_ese_deterministic_per_seed():
+    rng = np.random.RandomState(4)
+    X = rng.rand(40, 2)
+    a = native.ese_optimize(X, seed=11)
+    b = native.ese_optimize(X, seed=11)
+    c = native.ese_optimize(X, seed=12)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_ese_input_not_mutated():
+    rng = np.random.RandomState(5)
+    X = rng.rand(30, 2)
+    X_orig = X.copy()
+    native.ese_optimize(X, seed=0)
+    np.testing.assert_array_equal(X, X_orig)
+
+
+def test_lhs_ese_criterion_uses_native(monkeypatch):
+    calls = {}
+    real = native.ese_optimize
+
+    def spy(X, **kw):
+        calls["hit"] = True
+        return real(X, **kw)
+
+    monkeypatch.setattr(native, "ese_optimize", spy)
+    pts = LHS(xlimits=np.array([[-1.0, 1.0], [0.0, 1.0]]),
+              criterion="ese", random_state=0)(40)
+    assert calls.get("hit")
+    assert pts.shape == (40, 2)
+    assert np.isfinite(pts).all()

@@ -43,9 +43,9 @@ def test_weight_formula():
     # two terms with analytically known traces: e1 = w*c1 -> tr = sum(c1^2)
     c1 = jnp.array([1.0, 2.0])
     c2 = jnp.array([3.0])
-    fn1 = lambda p: p["w"] * c1          # noqa: E731
-    fn2 = lambda p: p["w"] * c2          # noqa: E731
-    ntk = make_ntk_weight_fn([fn1], [fn2])
+    fn1 = lambda p: p["w"] * c1                       # noqa: E731
+    res_all = lambda p: (p["w"] * c2).reshape(1, -1)  # noqa: E731
+    ntk = make_ntk_weight_fn([fn1], res_all, n_residuals=1)
     lam = ntk(params)
     tr1, tr2 = 5.0, 9.0
     np.testing.assert_allclose(sc(lam["BCs"][0]), (tr1 + tr2) / tr1,
@@ -100,14 +100,52 @@ def test_ntk_weights_balance_traces():
     # traces) — verify via the error fns the solver itself built
     from tensordiffeq_tpu.ops.ntk import build_error_fns
     s = make_ac()
-    bc_fns, res_fns, _ = build_error_fns(
+    bc_fns, res_all_fn, _ = build_error_fns(
         s.apply_fn, s.domain.vars, s.n_out, s.f_model, s.bcs, s.X_f,
         n_residuals=1)
     lam = s._ntk_fn(s.params)
-    traces = [float(trace_K(f, s.params)) for f in bc_fns + res_fns]
+    traces = [float(trace_K(f, s.params)) for f in bc_fns + [res_all_fn]]
     lams = [sc(v) for v in lam["BCs"] + lam["residual"]]
     products = [l * t for l, t in zip(lams, traces)]
     np.testing.assert_allclose(products, sum(traces), rtol=1e-3)
+
+
+def test_ntk_weights_assimilation_data_term():
+    # NTK balancing must cover the Data loss term: λ_data enters the lambdas
+    # pytree, gets balanced (λ_i · tr_i equal across terms), and scales the
+    # Data component of the loss
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(128, seed=0)
+    bcs = [IC(domain, [lambda x: np.sin(np.pi * x)], var=[["x"]])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
+
+    s = CollocationSolverND(assimilate=True, verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, Adaptive_type=3)
+    rng = np.random.RandomState(0)
+    xd = rng.uniform(-1, 1, 32)
+    td = rng.uniform(0, 1, 32)
+    s.compile_data(xd, td, np.sin(np.pi * xd) * np.exp(-td))
+
+    assert "data" in s.lambdas and len(s.lambdas["data"]) == 1
+    lam = s._ntk_fn(s.params)
+    assert "data" in lam and np.isfinite(sc(lam["data"][0]))
+
+    # λ_data scales the Data component
+    s.lambdas = jax.tree_util.tree_map(lambda x: x, lam)  # adopt balanced λ
+    _, comps1 = s.update_loss()
+    s.lambdas["data"] = [2.0 * lam["data"][0]]
+    _, comps2 = s.update_loss()
+    np.testing.assert_allclose(2.0 * float(comps1["Data"]),
+                               float(comps2["Data"]), rtol=1e-6)
+
+    # end-to-end: trains and refreshes every weight including λ_data
+    s.lambdas = lam
+    s.fit(tf_iter=20, newton_iter=5, chunk=10)
+    assert np.isfinite(float(s.min_loss["overall"]))
 
 
 def test_ntk_rejects_explicit_weights():

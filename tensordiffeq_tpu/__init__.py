@@ -30,7 +30,7 @@ Quick start (Burgers)::
 
 from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
-from . import parallel, plotting, sampling, training, utils  # noqa: F401
+from . import parallel, plotting, profiling, sampling, training, utils  # noqa: F401
 from . import models  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
@@ -38,6 +38,7 @@ from .domains import DomainND  # noqa: F401
 from .helpers import find_L2_error  # noqa: F401
 from .models import CollocationSolverND, DiscoveryModel  # noqa: F401
 from .networks import MLP, neural_net  # noqa: F401
-from .ops import MSE, UFn, d, g_MSE, grad, laplacian  # noqa: F401
+from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
+                  set_default_grad_mode)
 
 __version__ = "0.1.0"

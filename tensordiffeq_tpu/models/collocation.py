@@ -252,12 +252,22 @@ class CollocationSolverND:
     # ------------------------------------------------------------------ #
     def fit(self, tf_iter: int = 0, newton_iter: int = 0,
             batch_sz: Optional[int] = None, newton_eager: bool = True,
-            chunk: int = 100):
+            chunk: int = 100, profile_dir: Optional[str] = None):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
         ``fit.py:17-102``).  ``newton_eager`` is accepted for signature parity
-        but both L-BFGS paths here are on-device jitted loops."""
+        but both L-BFGS paths here are on-device jitted loops.
+
+        ``profile_dir``: capture an XLA profiler trace of the whole run into
+        this directory (first-class version of the reference's commented-out
+        ``tf.profiler`` stubs, ``fit.py:39,57-59`` — SURVEY §5)."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before fit(...)")
+        if profile_dir is not None:
+            from ..profiling import trace
+            with trace(profile_dir):
+                return self.fit(tf_iter=tf_iter, newton_iter=newton_iter,
+                                batch_sz=batch_sz, newton_eager=newton_eager,
+                                chunk=chunk)
         if self.verbose:
             print_screen(self)
 

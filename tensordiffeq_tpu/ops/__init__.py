@@ -1,5 +1,6 @@
 """Compute primitives: losses, derivative combinators, mesh builders."""
 
-from .derivatives import UFn, d, grad, laplacian, make_ufn, vmap_residual  # noqa: F401
+from .derivatives import (UFn, d, grad, laplacian, make_ufn,  # noqa: F401
+                          set_default_grad_mode, vmap_residual)
 from .losses import MSE, default_g, g_MSE, relative_l2  # noqa: F401
 from .meshes import flatten_and_stack, grid_points, multimesh  # noqa: F401

@@ -1,0 +1,99 @@
+"""First-class tracing/profiling.
+
+The reference ships only commented-out ``tf.profiler`` stubs and ad-hoc
+``time.time()`` bookkeeping (``fit.py:39,57-59,91,217-219``,
+``optimizers.py:118,282-284``).  Here profiling is a supported surface:
+XLA/TPU traces via :func:`jax.profiler` (viewable in TensorBoard /
+Perfetto), named trace annotations for phase attribution, and a
+``block_until_ready``-correct timer for honest device timings (an async
+dispatch returns before the device finishes; naive ``time.time()`` around a
+jitted call measures dispatch, not execution).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture an XLA profiler trace into ``log_dir``.
+
+    Usage::
+
+        with tdq.profiling.trace("/tmp/tb"):
+            solver.fit(tf_iter=1000)
+
+    View with ``tensorboard --logdir /tmp/tb`` (or pass
+    ``create_perfetto_link=True`` for a Perfetto UI link).
+    """
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline::
+
+        with tdq.profiling.annotate("lbfgs-phase"):
+            ...
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timeit(fn: Callable, *args, iters: int = 10, warmup: int = 1,
+           **kwargs) -> dict[str, Any]:
+    """Wall-clock a (usually jitted) function with correct device sync.
+
+    Runs ``warmup`` untimed calls (compilation), then ``iters`` timed calls
+    with ``jax.block_until_ready`` on each result.  Returns
+    ``{"mean_s", "min_s", "max_s", "iters", "result"}``.
+    """
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = fn(*args, **kwargs)
+    jax.block_until_ready(result)
+    times = []
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+        times.append(time.perf_counter() - t0)
+    return {"mean_s": sum(times) / len(times), "min_s": min(times),
+            "max_s": max(times), "iters": len(times), "result": result}
+
+
+@contextlib.contextmanager
+def stopwatch(label: str = "", sync: Optional[Any] = None,
+              verbose: bool = True):
+    """Context timer; pass ``sync=`` a pytree of device arrays to block on
+    before stopping the clock.  Yields a dict whose ``"elapsed_s"`` is filled
+    on exit."""
+    out: dict[str, Any] = {"label": label, "elapsed_s": None}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        if sync is not None:
+            jax.block_until_ready(sync)
+        out["elapsed_s"] = time.perf_counter() - t0
+        if verbose and label:
+            print(f"[profile] {label}: {out['elapsed_s']:.3f}s")
+
+
+def device_memory_stats() -> dict[str, dict]:
+    """Per-device memory statistics (bytes in use / peak / limit) where the
+    backend reports them; empty dict entries otherwise."""
+    stats = {}
+    for dev in jax.devices():
+        try:
+            stats[str(dev)] = dict(dev.memory_stats() or {})
+        except Exception:
+            stats[str(dev)] = {}
+    return stats

@@ -93,3 +93,44 @@ def test_multi_residual_tuple():
     r = vmap_residual(f_model, u, 2)(X)
     assert isinstance(r, tuple) and len(r) == 2
     assert r[0].shape == (10,)
+
+
+def test_fwd_and_rev_modes_agree():
+    """Forward-mode (default) and reverse-mode grad chains must match to
+    float tolerance, including second order and mixed partials."""
+    import jax.numpy as jnp
+    from tensordiffeq_tpu.ops.derivatives import UFn, grad
+
+    def fn(x, t):
+        return jnp.sin(2.0 * x) * jnp.exp(-0.5 * t) + x ** 3 * t
+
+    u = UFn(fn, ("x", "t"))
+    pts = [(0.3, 0.7), (-1.2, 0.1), (2.0, -0.4)]
+    for make in [lambda m: grad(u, "x", mode=m),
+                 lambda m: grad(grad(u, "x", mode=m), "x", mode=m),
+                 lambda m: grad(grad(u, "x", mode=m), "t", mode=m),
+                 lambda m: grad(u, "t", mode=m)]:
+        f_fwd, f_rev = make("fwd"), make("rev")
+        for x, t in pts:
+            a, b = float(f_fwd(x, t)), float(f_rev(x, t))
+            assert abs(a - b) < 1e-5, (a, b)
+
+
+def test_set_default_grad_mode_validates():
+    import pytest
+
+    from tensordiffeq_tpu.ops.derivatives import set_default_grad_mode
+
+    with pytest.raises(ValueError):
+        set_default_grad_mode("taylor")
+    set_default_grad_mode("rev")
+    set_default_grad_mode("fwd")
+
+
+def test_fwd_grad_rejects_vector_output():
+    """A vector-output function mis-declared as scalar must raise (parity
+    with jax.grad's scalar-output validation, kept in fwd mode)."""
+    import pytest
+
+    with pytest.raises(TypeError):
+        grad(lambda x, t: jnp.stack([x * t, x + t]), 0)(0.5, 0.5)

@@ -62,7 +62,8 @@ def build_loss_fn(apply_fn: Callable,
                   weight_outside_sum: bool = False,
                   g: Optional[Callable] = None,
                   data_X: Optional[jnp.ndarray] = None,
-                  data_s: Optional[jnp.ndarray] = None) -> Callable:
+                  data_s: Optional[jnp.ndarray] = None,
+                  residual_fn: Optional[Callable] = None) -> Callable:
     """Assemble ``loss(params, lam_bcs, lam_res, X_batch)``.
 
     Args:
@@ -74,6 +75,9 @@ def build_loss_fn(apply_fn: Callable,
       weight_outside_sum: SA type-2 semantics (λ scales the term's mean).
       g: optional λ transform for residual terms (``g_MSE``).
       data_X / data_s: optional assimilation observations.
+      residual_fn: optional fused batched residual ``(params, X) -> preds``
+        (one Taylor wavefront, :mod:`tensordiffeq_tpu.ops.fused`); the
+        generic per-point engine is used when ``None``.
 
     Returns a pure function
     ``loss(params, lam_bcs, lam_res, X_batch, lam_data=None) ->
@@ -142,7 +146,10 @@ def build_loss_fn(apply_fn: Callable,
             components[f"BC_{i}"] = loss_bc
             loss_bcs = loss_bcs + loss_bc
 
-        f_preds = _as_tuple(vmap_residual(f_model, u, ndim)(X_batch))
+        if residual_fn is not None:
+            f_preds = _as_tuple(residual_fn(params, X_batch))
+        else:
+            f_preds = _as_tuple(vmap_residual(f_model, u, ndim)(X_batch))
         loss_res = 0.0
         for j, f_pred in enumerate(f_preds):
             f_pred = f_pred.reshape(-1, 1)

@@ -70,7 +70,8 @@ class CollocationSolverND:
                 dict_adaptive: Optional[dict] = None,
                 init_weights: Optional[dict] = None,
                 g: Optional[Callable] = None, dist: bool = False,
-                network=None, lr: float = 0.005, lr_weights: float = 0.005):
+                network=None, lr: float = 0.005, lr_weights: float = 0.005,
+                fused: Optional[bool] = None):
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
@@ -86,6 +87,11 @@ class CollocationSolverND:
           dist: shard collocation points (and per-point λ) over all local
             devices (reference ``dist=True``, ``models.py:235``).
           network: optional custom Flax module replacing the default MLP.
+          fused: residual engine selection.  ``None`` (default) auto-uses the
+            fused Taylor-propagation engine (:mod:`..ops.fused`) when
+            ``f_model`` and the network qualify, falling back silently to
+            per-point autodiff; ``False`` forces the generic engine;
+            ``True`` requires fusion and raises if it isn't possible.
         """
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
@@ -96,6 +102,7 @@ class CollocationSolverND:
         self.f_model = f_model
         self.g = g
         self.dist = dist
+        self.fused = fused
         self.lr = lr
         self.lr_weights = lr_weights
         self.n_out = int(layer_sizes[-1])
@@ -175,6 +182,36 @@ class CollocationSolverND:
         self._build()
         self._compiled = True
 
+    def _try_fuse(self):
+        """Build the fused Taylor-propagation residual when both the network
+        (standard tanh MLP) and ``f_model`` (analyzable grad-combinator use)
+        qualify; ``None`` -> generic per-point engine."""
+        import flax.linen as nn
+
+        from ..networks import MLP
+        from ..ops.fused import analyze_f_model, make_fused_residual
+        from ..ops.taylor import extract_mlp_layers
+
+        # exact type: an MLP subclass may override __call__ (skip
+        # connections, feature maps) while keeping Dense params — fusing
+        # would silently differentiate a different network
+        if type(self.net) is not MLP:
+            return None
+        if self.net.activation not in (nn.tanh, jnp.tanh):
+            return None
+        if (self.net.dtype != jnp.float32
+                or self.net.param_dtype != jnp.float32):
+            # the Taylor propagation runs float32; a bf16-configured net
+            # would diverge from the generic engine's numerics
+            return None
+        if extract_mlp_layers(self.params) is None:
+            return None
+        requests = analyze_f_model(self.f_model, self.domain.vars, self.n_out)
+        if requests is None:
+            return None
+        return make_fused_residual(self.f_model, self.domain.vars, self.n_out,
+                                   requests, precision=self.net.precision)
+
     def _count_residuals(self) -> int:
         """Number of residual components ``f_model`` returns (trace once on
         a single point; multi-equation systems return a tuple)."""
@@ -186,16 +223,29 @@ class CollocationSolverND:
         return len(out) if isinstance(out, tuple) else 1
 
     def _build(self):
+        self._fused_residual = self._try_fuse() if self.fused is not False \
+            else None
+        if self.fused is True and self._fused_residual is None:
+            raise ValueError(
+                "fused=True but the residual cannot be fused: it requires "
+                "the standard tanh MLP and an f_model using grad() "
+                "combinators on untransformed coordinates with derivative "
+                "orders <= 2 (or unmixed 3rd)")
         self.loss_fn = build_loss_fn(
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
-            data_X=self.data_X, data_s=self.data_s)
+            data_X=self.data_X, data_s=self.data_s,
+            residual_fn=self._fused_residual)
 
         # jit-cached inference paths (params are traced args, so repeated
         # predict() calls reuse one compiled program)
-        def residual(params, X):
-            u = make_ufn(self.apply_fn, params, self.domain.vars, self.n_out)
-            return vmap_residual(self.f_model, u, self.domain.ndim)(X)
+        if self._fused_residual is not None:
+            residual = self._fused_residual
+        else:
+            def residual(params, X):
+                u = make_ufn(self.apply_fn, params, self.domain.vars,
+                             self.n_out)
+                return vmap_residual(self.f_model, u, self.domain.ndim)(X)
 
         self._residual_jit = jax.jit(residual)
         self._apply_jit = jax.jit(self.apply_fn)

@@ -57,6 +57,13 @@ class UFn:
             return self
         return UFn(lambda *c: self._fn(*c)[k], self.varnames, n_out=1)
 
+    def differentiate(self, num: int, mode: str) -> "UFn":
+        """Derivative along coordinate position ``num`` (used by
+        :func:`grad`; symbolic subclasses override this)."""
+        dfn = (_directional(self._fn, num) if mode == "fwd"
+               else jax.grad(self._fn, argnums=num))
+        return UFn(dfn, self.varnames, n_out=1)
+
     def argnum(self, var: Union[str, int]) -> int:
         if isinstance(var, int):
             return var
@@ -123,10 +130,7 @@ def grad(u: Union[UFn, Callable], var: Union[str, int] = 0,
             raise ValueError(
                 "grad() needs a scalar function; select a component first, "
                 "e.g. grad(u[0], 'x')")
-        num = u.argnum(var)
-        dfn = (_directional(u._fn, num) if mode == "fwd"
-               else jax.grad(u._fn, argnums=num))
-        return UFn(dfn, u.varnames, n_out=1)
+        return u.differentiate(u.argnum(var), mode)
     if not isinstance(var, int):
         raise ValueError("grad(fn, 'name') requires a UFn; pass an int argnum")
     dfn = _directional(u, var) if mode == "fwd" else jax.grad(u, argnums=var)

@@ -1,0 +1,212 @@
+"""Fused Taylor-propagation residual engine: parity with the generic
+per-point autodiff engine, and fallback safety."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.networks import neural_net
+from tensordiffeq_tpu.ops.derivatives import d, grad, laplacian, make_ufn, vmap_residual
+from tensordiffeq_tpu.ops.fused import analyze_f_model, make_fused_residual
+from tensordiffeq_tpu.ops.taylor import (canonical, closure, supported,
+                                         taylor_derivatives, extract_mlp_layers)
+
+
+def _setup(n_out=1, widths=(16, 16), seed=0, ndim=2):
+    net = neural_net([ndim, *widths, n_out])
+    params = net.init(jax.random.PRNGKey(seed), jnp.zeros((1, ndim)))
+    X = jnp.asarray(np.random.RandomState(seed).randn(64, ndim) * 0.5,
+                    jnp.float32)
+    return net, params, X
+
+
+def _generic(f_model, net, params, ndim, n_out=1):
+    u = make_ufn(net.apply, params, ("x", "t", "y")[:ndim], n_out)
+    return vmap_residual(f_model, u, ndim)
+
+
+# --------------------------------------------------------------------- #
+def test_taylor_derivatives_match_autodiff():
+    net, params, X = _setup()
+    layers = extract_mlp_layers(params)
+    reqs = {(), (0,), (1,), (0, 0), (0, 1), (0, 0, 0)}
+    table = taylor_derivatives(layers, X, reqs)
+
+    def u_scalar(x, t):
+        return net.apply(params, jnp.stack([x, t]))[0]
+
+    checks = {
+        (): u_scalar,
+        (0,): jax.grad(u_scalar, 0),
+        (1,): jax.grad(u_scalar, 1),
+        (0, 0): jax.grad(jax.grad(u_scalar, 0), 0),
+        (0, 1): jax.grad(jax.grad(u_scalar, 0), 1),
+        (0, 0, 0): jax.grad(jax.grad(jax.grad(u_scalar, 0), 0), 0),
+    }
+    for mi, fn in checks.items():
+        want = jax.vmap(fn)(X[:, 0], X[:, 1])
+        got = table[mi][:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5), mi
+
+
+def test_fused_burgers_residual_parity():
+    net, params, X = _setup()
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                - 0.01 * grad(u_x, "x")(x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    assert reqs == {(), (0,), (1,), (0, 0)}
+    fused = make_fused_residual(f_model, ("x", "t"), 1, reqs)
+    np.testing.assert_allclose(
+        np.asarray(fused(params, X)),
+        np.asarray(_generic(f_model, net, params, 2)(X)),
+        rtol=2e-4, atol=2e-5)
+
+
+def test_fused_third_order_and_laplacian():
+    net, params, X = _setup(ndim=2)
+
+    def f_model(u, x, t):  # KdV-ish: u_t + u u_x + u_xxx, plus a laplacian
+        return (grad(u, "t")(x, t) + u(x, t) * grad(u, "x")(x, t)
+                + d(u, "x", 3)(x, t) + 0.5 * laplacian(u)(x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    assert (0, 0, 0) in reqs and (1, 1) in reqs
+    fused = make_fused_residual(f_model, ("x", "t"), 1, reqs)
+    np.testing.assert_allclose(
+        np.asarray(fused(params, X)),
+        np.asarray(_generic(f_model, net, params, 2)(X)),
+        rtol=5e-4, atol=5e-5)
+
+
+def test_fused_vector_system_parity():
+    net, params, X = _setup(n_out=2)
+
+    def f_model(u, x, t):  # coupled system, tuple residual
+        p, q = u[0], u[1]
+        f1 = grad(p, "t")(x, t) - d(q, "x", 2)(x, t) + p(x, t) * q(x, t)
+        f2 = grad(q, "t")(x, t) + d(p, "x", 2)(x, t)
+        return f1, f2
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 2)
+    assert reqs is not None
+    fused = make_fused_residual(f_model, ("x", "t"), 2, reqs)
+    got = fused(params, X)
+    want = _generic(f_model, net, params, 2, n_out=2)(X)
+    assert isinstance(got, tuple) and len(got) == 2
+    for g_arr, w_arr in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g_arr), np.asarray(w_arr),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_gradient_wrt_params_parity():
+    """Reverse-mode through the fused propagation must match the generic
+    engine's parameter gradients (the training-step quantity)."""
+    net, params, X = _setup()
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * d(u, "x", 2)(x, t) + u(x, t) ** 3
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    fused = make_fused_residual(f_model, ("x", "t"), 1, reqs)
+
+    g1 = jax.grad(lambda p: jnp.mean(fused(p, X) ** 2))(params)
+    g2 = jax.grad(lambda p: jnp.mean(
+        _generic(f_model, net, p, 2)(X) ** 2))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-6)
+
+
+# --------------------------------------------------------------------- #
+def test_analysis_rejects_shifted_coordinates():
+    def f_model(u, x, t):
+        return u(x + 0.5, t)  # u off the collocation point: not fusable
+
+    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+
+
+def test_analysis_rejects_reordered_coordinates():
+    def f_model(u, x, t):
+        return u(t, x)
+
+    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+
+
+def test_analysis_rejects_fourth_order():
+    def f_model(u, x, t):
+        return d(u, "x", 4)(x, t)
+
+    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+
+
+def test_analysis_rejects_mixed_third_order():
+    def f_model(u, x, t):
+        return grad(grad(grad(u, "x"), "x"), "t")(x, t)
+
+    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+
+
+def test_multi_index_helpers():
+    assert canonical((1, 0)) == (0, 1)
+    assert supported((0, 1)) and supported((2, 2, 2)) and supported(())
+    assert not supported((0, 0, 1)) and not supported((0, 0, 0, 0))
+    firsts, seconds, thirds = closure({(0, 0, 0), (0, 1)})
+    assert (0,) in firsts and (1,) in firsts
+    assert (0, 0) in seconds and (0, 1) in seconds
+    assert thirds == [(0, 0, 0)]
+
+
+# --------------------------------------------------------------------- #
+def test_solver_auto_fuses_and_matches_generic():
+    """End-to-end: compile twice (auto vs fused=False); losses must agree."""
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 32)
+    domain.add("t", [0.0, 1.0], 16)
+    domain.generate_collocation_points(256, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                - (0.01 / np.pi) * grad(u_x, "x")(x, t))
+
+    losses = {}
+    for label, fused in [("fused", None), ("generic", False)]:
+        s = CollocationSolverND(verbose=False, seed=0)
+        s.compile([2, 12, 12, 1], f_model, domain, bcs, fused=fused)
+        if label == "fused":
+            assert s._fused_residual is not None
+        else:
+            assert s._fused_residual is None
+        total, comps = s.update_loss()
+        losses[label] = float(total)
+    assert np.isclose(losses["fused"], losses["generic"], rtol=1e-5)
+
+
+def test_solver_fused_true_raises_when_not_fusable():
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+    bcs = [IC(domain, [lambda x: 0.0 * x], var=[["x"]])]
+
+    def bad_f_model(u, x, t):  # off-point evaluation: not fusable
+        return u(x * 2.0, t)
+
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError, match="fused=True"):
+        s.compile([2, 8, 1], bad_f_model, domain, bcs, fused=True)

@@ -91,7 +91,10 @@ class CollocationSolverND:
             fused Taylor-propagation engine (:mod:`..ops.fused`) when
             ``f_model`` and the network qualify, falling back silently to
             per-point autodiff; ``False`` forces the generic engine;
-            ``True`` requires fusion and raises if it isn't possible.
+            ``True`` requires fusion and raises if it isn't possible;
+            ``"pallas"`` additionally requires the VMEM-resident pallas
+            kernel table producer (:mod:`..ops.pallas_taylor`; runs in
+            interpreter mode off-TPU).
         """
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
@@ -185,13 +188,16 @@ class CollocationSolverND:
     def _try_fuse(self):
         """Build the fused Taylor-propagation residual when both the network
         (standard tanh MLP) and ``f_model`` (analyzable grad-combinator use)
-        qualify; ``None`` -> generic per-point engine."""
+        qualify; ``None`` -> generic per-point engine.  Records the analysis
+        failure in ``_fuse_fail_reason`` so ``fused=True`` errors show the
+        real cause (e.g. a typo inside the user's f_model)."""
         import flax.linen as nn
 
         from ..networks import MLP
         from ..ops.fused import analyze_f_model, make_fused_residual
         from ..ops.taylor import extract_mlp_layers
 
+        self._fuse_fail_reason = None
         # exact type: an MLP subclass may override __call__ (skip
         # connections, feature maps) while keeping Dense params — fusing
         # would silently differentiate a different network
@@ -204,13 +210,25 @@ class CollocationSolverND:
             # the Taylor propagation runs float32; a bf16-configured net
             # would diverge from the generic engine's numerics
             return None
-        if extract_mlp_layers(self.params) is None:
+        layers = extract_mlp_layers(self.params)
+        if layers is None:
             return None
-        requests = analyze_f_model(self.f_model, self.domain.vars, self.n_out)
+        requests, reason = analyze_f_model(
+            self.f_model, self.domain.vars, self.n_out, return_reason=True)
         if requests is None:
+            self._fuse_fail_reason = reason
             return None
+
+        table_producer = None
+        if self.fused == "pallas":
+            from ..ops import pallas_taylor
+            shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
+            table_producer = pallas_taylor.build_pallas_table_fn(
+                requests, shapes, precision=self.net.precision,
+                interpret=not pallas_taylor.available())
         return make_fused_residual(self.f_model, self.domain.vars, self.n_out,
-                                   requests, precision=self.net.precision)
+                                   requests, precision=self.net.precision,
+                                   table_producer=table_producer)
 
     def _count_residuals(self) -> int:
         """Number of residual components ``f_model`` returns (trace once on
@@ -225,12 +243,17 @@ class CollocationSolverND:
     def _build(self):
         self._fused_residual = self._try_fuse() if self.fused is not False \
             else None
-        if self.fused is True and self._fused_residual is None:
-            raise ValueError(
-                "fused=True but the residual cannot be fused: it requires "
-                "the standard tanh MLP and an f_model using grad() "
-                "combinators on untransformed coordinates with derivative "
-                "orders <= 2 (or unmixed 3rd)")
+        if self.fused in (True, "pallas") and self._fused_residual is None:
+            msg = ("fused=%r but the residual cannot be fused: it requires "
+                   "the standard float32 tanh MLP and an f_model using "
+                   "grad() combinators on untransformed coordinates with "
+                   "derivative orders <= 2 (or unmixed 3rd)" % (self.fused,))
+            reason = getattr(self, "_fuse_fail_reason", None)
+            if reason is not None:
+                raise ValueError(f"{msg}; analysis stopped on: "
+                                 f"{type(reason).__name__}: {reason}") \
+                    from reason
+            raise ValueError(msg)
         self.loss_fn = build_loss_fn(
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,

@@ -13,9 +13,11 @@ solver silently keeps the generic per-point autodiff engine.
 When analysis succeeds and the network is the standard tanh MLP, the batched
 residual becomes: one :func:`~.taylor.taylor_derivatives` wavefront producing
 every requested ∂ᵅu as an ``[N, n_out]`` array, then a vmapped re-run of
-``f_model`` where ``u`` and its derivatives are table lookups.  Identical
-values (same floating-point contractions through the shared matmuls), several
-times fewer network traversals.
+``f_model`` where ``u`` and its derivatives are table lookups.  Values agree
+with the generic engine to float32 round-off (the contraction order through
+the shared stacked matmuls differs from per-point jvp chains, so expect
+~1e-4 relative drift, not bit identity) with several times fewer network
+traversals.
 """
 
 from __future__ import annotations
@@ -107,20 +109,28 @@ class SymbolicUFn(UFn):
 
 
 def analyze_f_model(f_model: Callable, varnames: Sequence[str],
-                    n_out: int) -> Optional[set]:
+                    n_out: int, return_reason: bool = False):
     """Dry-run ``f_model`` symbolically.  Returns the set of canonical
-    multi-indices it requests, or ``None`` if it isn't fusable."""
+    multi-indices it requests, or ``None`` if it isn't fusable.
+
+    With ``return_reason=True`` returns ``(requests_or_None, reason)`` where
+    ``reason`` is the exception that stopped the analysis — an
+    :class:`_AbortAnalysis` for structurally-unfusable models, or the user's
+    own error (so ``fused=True`` failures can show the real cause instead of
+    a generic "cannot be fused")."""
     engine = _AnalysisEngine(len(varnames))
     u = SymbolicUFn(engine, varnames, n_out)
+    reason = None
     try:
         f_model(u, *engine.tokens)
-    except _AbortAnalysis:
-        return None
-    except Exception:
-        # anything else (tracer leaks, shape errors on the dummy zeros, …):
-        # let the generic engine surface the real error to the user
-        return None
-    return engine.requests | {()}
+    except _AbortAnalysis as e:
+        reason = e
+    except Exception as e:
+        # anything else (typos in f_model, shape errors on the dummies, …):
+        # fall back so the generic engine surfaces the real error in context
+        reason = e
+    requests = None if reason is not None else engine.requests | {()}
+    return (requests, reason) if return_reason else requests
 
 
 def make_fused_residual(f_model: Callable, varnames: Sequence[str],

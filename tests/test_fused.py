@@ -210,3 +210,49 @@ def test_solver_fused_true_raises_when_not_fusable():
     s = CollocationSolverND(verbose=False)
     with pytest.raises(ValueError, match="fused=True"):
         s.compile([2, 8, 1], bad_f_model, domain, bcs, fused=True)
+
+
+def test_solver_fused_pallas_matches_generic():
+    """fused='pallas' routes the residual through the pallas table producer
+    (interpreter mode off-TPU) and agrees with the generic engine."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(96, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                - 0.01 * grad(u_x, "x")(x, t))
+
+    totals = {}
+    for label, fused in [("pallas", "pallas"), ("generic", False)]:
+        s = CollocationSolverND(verbose=False, seed=0)
+        s.compile([2, 10, 10, 1], f_model, domain, bcs, fused=fused)
+        totals[label] = float(s.update_loss()[0])
+    assert np.isclose(totals["pallas"], totals["generic"], rtol=1e-4)
+
+
+def test_fused_true_error_chains_user_bug():
+    """A typo inside f_model must surface in the fused=True error instead of
+    a bare 'cannot be fused'."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+    bcs = [IC(domain, [lambda x: 0.0 * x], var=[["x"]])]
+
+    def buggy_f_model(u, x, t):
+        return u(x, t) + undefined_name  # noqa: F821
+
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError, match="NameError") as exc_info:
+        s.compile([2, 8, 1], buggy_f_model, domain, bcs, fused=True)
+    assert isinstance(exc_info.value.__cause__, NameError)

@@ -1,0 +1,86 @@
+"""Plotting smoke tests (reference parity C11): every public plot function
+renders to a file without a display."""
+
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg")
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu.plotting import (figsize, get_griddata, newfig,
+                                       plot_glam_values, plot_residuals,
+                                       plot_solution_domain1D, plot_weights)
+
+
+class _FakeModel:
+    """Minimal object with the predict/lambdas/X_f surface plotting needs."""
+
+    def __init__(self, n=200):
+        rng = np.random.RandomState(0)
+        self.X_f = rng.rand(n, 2) * [2.0, 1.0] - [1.0, 0.0]
+        self.lambdas = {"residual": [rng.rand(n, 1).astype(np.float32)]}
+        self.g = None
+
+    def predict(self, X_star):
+        u = np.sin(np.pi * X_star[:, :1]) * np.exp(-X_star[:, 1:2])
+        return u, np.zeros_like(u)
+
+
+def test_figsize_and_newfig():
+    w, h = figsize(1.0)
+    assert w > 0 and h > 0
+    fig, ax = newfig(1.0)
+    assert fig is not None and ax is not None
+    matplotlib.pyplot.close(fig)
+
+
+def test_get_griddata_interpolates():
+    x = np.linspace(-1, 1, 20)
+    t = np.linspace(0, 1, 10)
+    X, T = np.meshgrid(x, t)
+    pts = np.hstack([X.flatten()[:, None], T.flatten()[:, None]])
+    vals = pts[:, 0] ** 2
+    grid = get_griddata(pts, vals, (X, T))
+    assert grid.shape == X.shape
+    assert np.nanmax(np.abs(grid - X ** 2)) < 1e-6
+
+
+def test_plot_solution_domain1d(tmp_path):
+    model = _FakeModel()
+    x = np.linspace(-1, 1, 32)
+    t = np.linspace(0, 1, 16)
+    exact = np.sin(np.pi * x)[:, None] * np.exp(-t)[None, :]
+    out = str(tmp_path / "sol.png")
+    plot_solution_domain1D(model, [x, t], ub=[1.0], lb=[-1.0],
+                           Exact_u=exact, save_path=out)
+    assert os.path.getsize(out) > 1000
+
+
+def test_plot_weights_and_glam(tmp_path):
+    model = _FakeModel()
+    p1 = str(tmp_path / "w.png")
+    p2 = str(tmp_path / "g.png")
+    plot_weights(model, save_path=p1)
+    plot_glam_values(model, save_path=p2)
+    assert os.path.getsize(p1) > 1000 and os.path.getsize(p2) > 1000
+
+
+def test_plot_weights_requires_adaptive():
+    model = _FakeModel()
+    model.lambdas = {"residual": [None]}
+    with pytest.raises(ValueError):
+        plot_weights(model)
+
+
+def test_plot_residuals(tmp_path):
+    rng = np.random.RandomState(0)
+    X_star = rng.rand(300, 2)
+    f = np.sin(X_star[:, 0] * 3)
+    x = np.linspace(0, 1, 24)
+    t = np.linspace(0, 1, 12)
+    out = str(tmp_path / "res.png")
+    plot_residuals(X_star, f, np.meshgrid(x, t), save_path=out)
+    assert os.path.getsize(out) > 1000

@@ -5,7 +5,8 @@ The generic residual path evaluates the user's ``f_model`` with per-point
 This module instead pushes ONE wavefront through the MLP that carries the
 primal together with every requested directional derivative (first, second —
 including mixed — and unmixed third order): per layer, all channels share a
-single stacked matmul (MXU-friendly ``[(1+C)·N, w]`` shapes) and the tanh
+single batched matmul (``[C, N, w]``, channels on a fresh leading axis so
+the point axis keeps its dist-training sharding) and the tanh
 derivative chain ``d1 = 1-z², d2 = -2·z·d1, d3 = -2·d1·(1-3z²)`` is applied
 elementwise (VPU, fused by XLA).  Reverse-mode AD composes through it for the
 loss gradient, so no custom VJP is required for correctness.
@@ -96,6 +97,9 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
     firsts, seconds, thirds = closure(set(map(canonical, requests)))
 
     # Channel wavefront. Z primal; T/S/U keyed by canonical multi-index.
+    # Channels stack on a NEW leading axis: the point axis keeps its
+    # position (and, under dist training, its sharding — stacking along the
+    # sharded axis would make GSPMD gather the batch at every layer).
     Z = X
     T = {idx: jnp.zeros_like(X).at[:, idx[0]].set(1.0) for idx in firsts}
     S = {idx: jnp.zeros_like(X) for idx in seconds}
@@ -106,12 +110,12 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
 
     n_layers = len(layers)
     for li, (W, b) in enumerate(layers):
-        stacked = jnp.concatenate(
+        stacked = jnp.stack(
             [Z] + [T[i] for i in firsts] + [S[i] for i in seconds]
-            + [U[i] for i in thirds], axis=0)
-        # one MXU matmul for every channel
+            + [U[i] for i in thirds], axis=0)  # [C, N, w_in]
+        # one (batched) MXU matmul for every channel
         out = jnp.matmul(stacked, W, precision=precision)
-        chunks = dict(zip(order, jnp.split(out, len(order), axis=0)))
+        chunks = dict(zip(order, out))
         P = chunks[("z", ())] + b
         Q = {i: chunks[("t", i)] for i in firsts}
         R = {i: chunks[("s", i)] for i in seconds}

@@ -116,7 +116,9 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
 
     grad_fn = jax.value_and_grad(loss_over_trainables, has_aux=True)
 
-    @partial(jax.jit, static_argnames=("n_steps",))
+    # donate the carried state: each chunk reuses the previous chunk's
+    # buffers instead of allocating fresh ones (callers pass copies in)
+    @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1, 2))
     def run(trainables, opt_state, best, X_batched, idx_batched, step0,
             n_steps: int):
         def step(carry, i):
@@ -178,7 +180,9 @@ def fit_adam(loss_fn: Callable,
     idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
 
     opt = make_optimizer(lr, lr_weights, freeze_lambdas=freeze_lambdas)
-    trainables = {"params": params, "lambdas": lambdas}
+    # copy: the chunk runner donates its carried state, and the caller's
+    # arrays (solver.params / restored opt_state) must stay valid
+    trainables = tree_copy({"params": params, "lambdas": lambdas})
     if lambda_update_fn is not None:  # e.g. NTK: balance before step 0
         trainables["lambdas"] = lambda_update_fn(trainables["params"])
     if opt_state is None:
@@ -188,6 +192,8 @@ def fit_adam(loss_fn: Callable,
             "opt_state does not match the current trainables (structure or "
             "shapes differ); was the checkpoint saved for a different "
             "configuration?")
+    else:
+        opt_state = tree_copy(opt_state)
     # classify per-point λ by the UNTRIMMED point count: λ keeps all N_f rows
     # even when batches drop a remainder, and only gathered rows get gradients
     run = _chunk_runner(loss_fn, opt, n_batches, N_f)

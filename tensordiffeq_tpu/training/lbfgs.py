@@ -50,7 +50,7 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
         linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
     value_and_grad = optax.value_and_grad_from_state(fun)
 
-    @partial(jax.jit, static_argnames=("n_steps",))
+    @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1, 2))
     def run_chunk(x, state, best, it0, n_steps: int):
         def step(carry, i):
             x, state, best = carry
@@ -76,8 +76,12 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             step, (x, state, best), jnp.arange(n_steps))
         return x, state, best, values, gnorms
 
-    state = opt.init(x0)
-    x = x0
+    # copies: run_chunk donates its carried state, so the caller's x0 (the
+    # solver's params) must stay valid — and opt.init's state aliases the
+    # params buffers, which donation forbids (double-donate), so the state
+    # is copied to distinct buffers too
+    x = tree_copy(x0)
+    state = tree_copy(opt.init(x))
     best = (tree_copy(x0), jnp.asarray(jnp.inf), jnp.asarray(-1))
     history: list[float] = []
     f_prev = np.inf

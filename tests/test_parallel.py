@@ -123,3 +123,26 @@ def test_dist_lbfgs_runs(eight_devices):
     s = make_problem()
     s.fit(tf_iter=10, newton_iter=10, chunk=10)
     assert np.isfinite(s.min_loss["l-bfgs"])
+
+
+def test_dist_fused_residual_sharded(eight_devices):
+    """The fused Taylor engine must compose with dist sharding: channels
+    stack on a fresh axis so the point axis keeps its PartitionSpec."""
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(256, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return grad(u, "t")(x, t) + u(x, t) * u_x(x, t) \
+            - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=0)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, dist=True)
+    assert s._fused_residual is not None
+    s.fit(tf_iter=6, newton_iter=0, chunk=3)
+    losses = [e["Total Loss"] for e in s.losses]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

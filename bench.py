@@ -45,7 +45,7 @@ def log(*a):
 # --------------------------------------------------------------------------- #
 # JAX (ours)
 # --------------------------------------------------------------------------- #
-def build_solver(n_f, nx, nt, widths, seed=0):
+def build_solver(n_f, nx, nt, widths, seed=0, fused=None):
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, grad, periodicBC
 
@@ -75,7 +75,8 @@ def build_solver(n_f, nx, nt, widths, seed=0):
         [2, *widths, 1], f_model, domain, bcs, Adaptive_type=1,
         dict_adaptive={"residual": [True], "BCs": [True, False]},
         init_weights={"residual": [rng.rand(n_f, 1)],
-                      "BCs": [100.0 * rng.rand(nx, 1), None]})
+                      "BCs": [100.0 * rng.rand(nx, 1), None]},
+        fused=fused)
     return solver
 
 
@@ -205,6 +206,50 @@ def get_baseline(n_f, nx, widths, n_steps):
 
 
 # --------------------------------------------------------------------------- #
+# --engines: residual-engine comparison (generic autodiff vs fused Taylor vs
+# pallas VMEM kernel) on the same SA train step
+# --------------------------------------------------------------------------- #
+def bench_engines(n_f, nx, nt, widths, n_steps):
+    import jax
+    import optax
+    from tensordiffeq_tpu.training.fit import make_optimizer
+
+    results = {}
+    for engine, fused in [("generic", False), ("fused-xla", True),
+                          ("fused-pallas", "pallas")]:
+        solver = build_solver(n_f, nx, nt, widths, fused=fused)
+        opt = make_optimizer()
+
+        def train_step(trainables, opt_state, X, solver=solver, opt=opt):
+            def loss_over(tr):
+                return solver.loss_fn(tr["params"], tr["lambdas"]["BCs"],
+                                      tr["lambdas"]["residual"], X)
+            (total, _), grads = jax.value_and_grad(
+                loss_over, has_aux=True)(trainables)
+            updates, opt_state = opt.update(grads, opt_state, trainables)
+            return optax.apply_updates(trainables, updates), opt_state, total
+
+        trainables = {"params": solver.params, "lambdas": solver.lambdas}
+        opt_state = opt.init(trainables)
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        t0 = time.time()
+        trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
+        jax.block_until_ready(loss)
+        compile_t = time.time() - t0
+        t0 = time.time()
+        for _ in range(n_steps):
+            trainables, opt_state, loss = step(trainables, opt_state,
+                                               solver.X_f)
+        jax.block_until_ready(loss)
+        dt = time.time() - t0
+        pts = n_f * n_steps / dt
+        results[engine] = pts
+        log(f"[engines] {engine}: compile {compile_t:.1f}s, "
+            f"{pts:,.0f} pts/sec (loss={float(loss):.4f})")
+    return results
+
+
+# --------------------------------------------------------------------------- #
 # --full: real training, time-to-L2
 # --------------------------------------------------------------------------- #
 def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
@@ -231,6 +276,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="train AC-SA to convergence and report time-to-L2")
+    ap.add_argument("--engines", action="store_true",
+                    help="compare generic / fused-xla / fused-pallas "
+                         "residual engines on the SA train step")
     args = ap.parse_args()
 
     fast = os.environ.get("BENCH_FAST") == "1"
@@ -238,6 +286,17 @@ def main():
     n_steps = int(os.environ.get("BENCH_STEPS", 10 if fast else 100))
     nx, nt = (64, 16) if fast else (512, 201)
     widths = [32, 32] if fast else [128, 128, 128, 128]
+
+    if args.engines:
+        results = bench_engines(n_f, nx, nt, widths, n_steps)
+        best = max(results, key=results.get)
+        print(json.dumps({
+            "metric": f"AC-SA step throughput by engine (best: {best})",
+            "value": round(results[best]),
+            "unit": "collocation-pts/sec/chip",
+            "vs_baseline": round(results[best] / results["generic"], 3),
+        }))
+        return
 
     if args.full:
         wall, l2 = bench_time_to_l2(n_f, nx, nt, widths,

@@ -36,3 +36,8 @@ def test_discovery_example_runs():
 
 def test_checkpoint_transfer_example_runs(tmp_path):
     run_example("transfer_learn.py")
+
+
+def test_kdv_example_runs():
+    """KdV: third-order derivative path end-to-end (fused engine)."""
+    run_example("kdv.py")

@@ -85,7 +85,9 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps):
     import optax
     from tensordiffeq_tpu.training.fit import make_optimizer
 
-    solver = build_solver(n_f, nx, nt, widths)
+    # autotune: measure generic vs fused residual engines at this exact
+    # config and keep the faster one for the headline number
+    solver = build_solver(n_f, nx, nt, widths, fused="autotune")
     opt = make_optimizer()
 
     def train_step(trainables, opt_state, X):

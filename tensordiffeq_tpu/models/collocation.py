@@ -94,7 +94,10 @@ class CollocationSolverND:
             ``True`` requires fusion and raises if it isn't possible;
             ``"pallas"`` additionally requires the VMEM-resident pallas
             kernel table producer (:mod:`..ops.pallas_taylor`; runs in
-            interpreter mode off-TPU).
+            interpreter mode off-TPU); ``"autotune"`` compiles the candidate
+            engines, times one full loss+grad step of each on the actual
+            collocation set, and keeps the fastest (compile cost up front,
+            best steady-state step guaranteed).
         """
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
@@ -230,6 +233,42 @@ class CollocationSolverND:
                                    requests, precision=self.net.precision,
                                    table_producer=table_producer)
 
+    def _autotune_engine(self):
+        """Time one jitted loss+grad step per candidate residual engine on
+        the real collocation set; return the fastest engine's residual_fn
+        (``None`` = generic).  Engine choice is config-dependent (network
+        width, N_f, backend), so measuring beats guessing."""
+        import time as _time
+
+        candidates = {"generic": None, "fused": self._fused_residual}
+        timings = {}
+        for name, res_fn in candidates.items():
+            loss_fn = build_loss_fn(
+                self.apply_fn, self.domain.vars, self.n_out, self.f_model,
+                self.bcs, weight_outside_sum=self.weight_outside_sum,
+                g=self.g, data_X=self.data_X, data_s=self.data_s,
+                residual_fn=res_fn)
+
+            def value_grad(params, X):
+                return jax.value_and_grad(
+                    lambda p: loss_fn(p, self.lambdas["BCs"],
+                                      self.lambdas["residual"], X)[0])(params)
+
+            step = jax.jit(value_grad)
+            out = step(self.params, self.X_f)  # compile + warm-up
+            jax.block_until_ready(out)
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                out = step(self.params, self.X_f)
+            jax.block_until_ready(out)
+            timings[name] = (_time.perf_counter() - t0) / 3
+        best = min(timings, key=timings.get)
+        if self.verbose:
+            shown = ", ".join(f"{k}={v * 1e3:.2f}ms"
+                              for k, v in timings.items())
+            print(f"[autotune] residual engine: {best} ({shown})")
+        return candidates[best]
+
     def _count_residuals(self) -> int:
         """Number of residual components ``f_model`` returns (trace once on
         a single point; multi-equation systems return a tuple)."""
@@ -254,6 +293,8 @@ class CollocationSolverND:
                                  f"{type(reason).__name__}: {reason}") \
                     from reason
             raise ValueError(msg)
+        if self.fused == "autotune" and self._fused_residual is not None:
+            self._fused_residual = self._autotune_engine()
         self.loss_fn = build_loss_fn(
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,

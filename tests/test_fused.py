@@ -256,3 +256,27 @@ def test_fused_true_error_chains_user_bug():
     with pytest.raises(ValueError, match="NameError") as exc_info:
         s.compile([2, 8, 1], buggy_f_model, domain, bcs, fused=True)
     assert isinstance(exc_info.value.__cause__, NameError)
+
+
+def test_solver_autotune_selects_an_engine():
+    """fused='autotune' times both engines and keeps a working one."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(128, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return grad(u, "t")(x, t) + u(x, t) * u_x(x, t) \
+            - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=0)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, fused="autotune")
+    total, _ = s.update_loss()
+    assert np.isfinite(float(total))
+    s.fit(tf_iter=4, newton_iter=0, chunk=2)
+    assert np.isfinite(s.losses[-1]["Total Loss"])

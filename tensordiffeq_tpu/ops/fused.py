@@ -59,11 +59,11 @@ class _AnalysisEngine:
 
 
 class _TableEngine:
-    """Serves recorded derivatives from the per-point table row."""
+    """Serves recorded derivatives from the batched derivative table."""
 
-    def __init__(self, tokens: tuple, row: dict):
+    def __init__(self, tokens: tuple, table: dict):
         self.tokens = tokens
-        self.row = row  # {multi_index: [n_out] vector}
+        self.table = table  # {multi_index: [N, n_out] array}
 
     def lookup(self, multi_index, component, coords, n_out):
         if len(coords) != len(self.tokens) or any(
@@ -71,10 +71,10 @@ class _TableEngine:
             raise RuntimeError(
                 "fused residual: u evaluated at unexpected coordinates "
                 "(analysis should have rejected this f_model)")
-        vec = self.row[canonical(multi_index)]
+        arr = self.table[canonical(multi_index)]
         if component is None and n_out > 1:
-            return vec
-        return vec[0 if component is None else component]
+            return arr  # [N, n_out]
+        return arr[:, 0 if component is None else component]  # [N]
 
 
 class SymbolicUFn(UFn):
@@ -158,11 +158,11 @@ def make_fused_residual(f_model: Callable, varnames: Sequence[str],
             table = taylor_derivatives(layers, X, requests,
                                        precision=precision)
 
-        def per_point(row, pt):
-            coords = tuple(pt[i] for i in range(ndim))
-            u = SymbolicUFn(_TableEngine(coords, row), varnames, n_out)
-            return f_model(u, *coords)
-
-        return jax.vmap(per_point)(table, X)
+        # ONE batched re-run of f_model: lookups return whole [N] columns
+        # (scalar arithmetic in f_model broadcasts over the batch exactly as
+        # it would over vmap tracers), so no per-point vmap layer is needed.
+        coords = tuple(X[:, i] for i in range(ndim))
+        u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
+        return f_model(u, *coords)
 
     return residual

@@ -6,27 +6,41 @@ Self-Adaptive PINN, N_f=50,000 collocation points, 2-128-128-128-128-1 tanh
 MLP, per-point residual λ + per-point IC λ (reference ``examples/AC-SA.py``)
 — as *training throughput in collocation-points/sec/chip*: full SA minimax
 Adam steps (loss + grads over params and λ + dual Adam update) timed on the
-default JAX backend.
+default JAX backend.  The JSON line also carries ``flops_per_step`` (XLA cost
+analysis of the compiled step) and ``mfu`` (achieved FLOP/s ÷ chip peak).
+
+Resilience: the measurement runs in a SUBPROCESS with a hard timeout — this
+host's TPU tunnel can hang or fail backend init (round-1 failure mode:
+"Unable to initialize backend 'axon'", BENCH_r01.json rc=1).  The supervisor
+retries once, then falls back to a CPU measurement tagged
+``"backend_note": "cpu-fallback"``, and on total failure still prints a JSON
+line with a ``diag`` field.  Exit code is always 0.
 
 ``vs_baseline`` is the ratio to a reference-style TensorFlow-2 train step
 (same network, same residual via nested GradientTape, same dual-Adam SA
-update, ``tf.function``-compiled) measured on the same host.  The reference
+update, ``tf.function``-compiled) measured on the same host; the reference
 framework has no TPU path — TF-on-this-host is what it can actually deliver
-here.  If TF is unavailable the last same-host TF measurement recorded in
-``BENCH_BASELINE_CACHE.json`` is used.
+here.  If TF is unavailable, the last same-host TF measurement recorded in
+``BENCH_BASELINE_CACHE.json`` is used; if neither exists, ``vs_baseline`` is
+``null`` (never a fake 1.0).
 
-``--full`` instead trains AC-SA for real (Adam + L-BFGS) and reports
-time-to-L2<2.1e-2 (the SA-PINN paper's reported accuracy, cited at reference
-``models.py:37``) against the spectral solution from
-:mod:`tensordiffeq_tpu.exact`.
+Modes:
+  (default)     SA train-step throughput + MFU
+  --engines     generic vs fused-XLA vs fused-pallas residual engines
+  --precision   float32(HIGHEST) vs bf16-matmul network forward config
+  --full        train AC-SA for real (Adam + L-BFGS) with periodic L2
+                evaluation; reports wall-clock to rel-L2 <= 2.1e-2 (the
+                SA-PINN paper figure cited at reference ``models.py:37``)
 
 Env knobs: ``BENCH_NF`` (default 50000), ``BENCH_STEPS`` (default 100),
-``BENCH_FAST=1`` (tiny smoke config).
+``BENCH_FAST=1`` (tiny smoke config), ``BENCH_TIMEOUT`` (per-attempt
+subprocess seconds).
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -37,15 +51,36 @@ CACHE = os.path.join(REPO, "BENCH_BASELINE_CACHE.json")
 
 EPS = 0.0001  # Allen-Cahn diffusion coefficient
 
+# Dense bf16 peak FLOP/s per chip (public figures; MFU basis).  The fp32
+# path runs below these peaks by design — quoting the bf16 basis is the
+# standard, conservative convention.
+PEAK_FLOPS = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def peak_flops_for(device_kind: str):
+    dk = device_kind.lower()
+    for key, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in dk:
+            return val
+    return None
+
+
 # --------------------------------------------------------------------------- #
 # JAX (ours)
 # --------------------------------------------------------------------------- #
-def build_solver(n_f, nx, nt, widths, seed=0, fused=None):
+_UNSET = object()
+
+
+def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
+                 precision=_UNSET):
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, grad, periodicBC
 
@@ -69,6 +104,17 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None):
         uv = u(x, t)
         return u_t(x, t) - EPS * u_xx(x, t) + 5.0 * uv ** 3 - 5.0 * uv
 
+    network = None
+    if dtype is not _UNSET or precision is not _UNSET:
+        import jax.numpy as jnp
+        from tensordiffeq_tpu.networks import neural_net
+        kw = {}
+        if dtype is not _UNSET:
+            kw["dtype"] = jnp.dtype(dtype).type
+        if precision is not _UNSET:
+            kw["precision"] = precision
+        network = neural_net([2, *widths, 1], **kw)
+
     rng = np.random.RandomState(seed)
     solver = CollocationSolverND(verbose=False)
     solver.compile(
@@ -76,18 +122,15 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None):
         dict_adaptive={"residual": [True], "BCs": [True, False]},
         init_weights={"residual": [rng.rand(n_f, 1)],
                       "BCs": [100.0 * rng.rand(nx, 1), None]},
-        fused=fused)
+        fused=fused, network=network)
     return solver
 
 
-def bench_jax_throughput(n_f, nx, nt, widths, n_steps):
+def make_sa_step(solver):
     import jax
     import optax
     from tensordiffeq_tpu.training.fit import make_optimizer
 
-    # autotune: measure generic vs fused residual engines at this exact
-    # config and keep the faster one for the headline number
-    solver = build_solver(n_f, nx, nt, widths, fused="autotune")
     opt = make_optimizer()
 
     def train_step(trainables, opt_state, X):
@@ -100,9 +143,36 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps):
 
     trainables = {"params": solver.params, "lambdas": solver.lambdas}
     opt_state = opt.init(trainables)
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    return train_step, trainables, opt_state
 
+
+def compiled_flops(compiled):
+    """FLOPs per step from the compiled executable's XLA cost model
+    (None if the backend doesn't expose it)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception as e:
+        log(f"[mfu] cost_analysis unavailable: {type(e).__name__}: {e}")
+        return None
+
+
+def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune"):
+    import jax
+
+    solver = build_solver(n_f, nx, nt, widths, fused=fused)
+    train_step, trainables, opt_state = make_sa_step(solver)
+
+    # ONE AOT compile serves both the cost analysis and the timed loop — a
+    # second jit of the same step would double warm-up inside the worker's
+    # timeout budget
     t0 = time.time()
+    step = jax.jit(train_step, donate_argnums=(0, 1)) \
+        .lower(trainables, opt_state, solver.X_f).compile()
+    flops_per_step = compiled_flops(step)
     trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
     jax.block_until_ready(loss)
     log(f"[jax] compile+first step: {time.time() - t0:.1f}s "
@@ -115,9 +185,20 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps):
     dt = time.time() - t0
     n_chips = max(1, len(jax.devices())) if jax.default_backend() != "cpu" else 1
     pts = n_f * n_steps / dt / n_chips
+    steps_per_sec = n_steps / dt
+
+    dev_kind = jax.devices()[0].device_kind
+    mfu = None
+    if flops_per_step is not None and jax.default_backend() == "tpu":
+        peak = peak_flops_for(dev_kind)
+        if peak:
+            mfu = flops_per_step * steps_per_sec / n_chips / peak
     log(f"[jax] {n_steps} SA steps in {dt:.2f}s -> {pts:,.0f} pts/sec/chip "
-        f"(loss={float(loss):.4f})")
-    return pts
+        f"(loss={float(loss):.4f}, flops/step={flops_per_step}, mfu={mfu})")
+    return {"pts_per_sec_per_chip": pts, "steps_per_sec": steps_per_sec,
+            "flops_per_step": flops_per_step, "mfu": mfu,
+            "device_kind": dev_kind, "backend": jax.default_backend(),
+            "loss": float(loss)}
 
 
 # --------------------------------------------------------------------------- #
@@ -213,49 +294,96 @@ def get_baseline(n_f, nx, widths, n_steps):
 # --------------------------------------------------------------------------- #
 def bench_engines(n_f, nx, nt, widths, n_steps):
     import jax
-    import optax
-    from tensordiffeq_tpu.training.fit import make_optimizer
 
-    results = {}
-    for engine, fused in [("generic", False), ("fused-xla", True),
-                          ("fused-pallas", "pallas")]:
-        solver = build_solver(n_f, nx, nt, widths, fused=fused)
-        opt = make_optimizer()
-
-        def train_step(trainables, opt_state, X, solver=solver, opt=opt):
-            def loss_over(tr):
-                return solver.loss_fn(tr["params"], tr["lambdas"]["BCs"],
-                                      tr["lambdas"]["residual"], X)
-            (total, _), grads = jax.value_and_grad(
-                loss_over, has_aux=True)(trainables)
-            updates, opt_state = opt.update(grads, opt_state, trainables)
-            return optax.apply_updates(trainables, updates), opt_state, total
-
-        trainables = {"params": solver.params, "lambdas": solver.lambdas}
-        opt_state = opt.init(trainables)
-        step = jax.jit(train_step, donate_argnums=(0, 1))
-        t0 = time.time()
-        trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
-        jax.block_until_ready(loss)
-        compile_t = time.time() - t0
-        t0 = time.time()
-        for _ in range(n_steps):
-            trainables, opt_state, loss = step(trainables, opt_state,
-                                               solver.X_f)
-        jax.block_until_ready(loss)
-        dt = time.time() - t0
-        pts = n_f * n_steps / dt
-        results[engine] = pts
-        log(f"[engines] {engine}: compile {compile_t:.1f}s, "
-            f"{pts:,.0f} pts/sec (loss={float(loss):.4f})")
-    return results
+    results, errors = {}, {}
+    n_chips = max(1, len(jax.devices())) if jax.default_backend() != "cpu" \
+        else 1
+    candidates = [("generic", False), ("fused-xla", True)]
+    from tensordiffeq_tpu.ops import pallas_taylor
+    if pallas_taylor.available():
+        candidates.append(("fused-pallas", "pallas"))
+    else:
+        log("[engines] pallas excluded (no real TPU backend); it runs only "
+            "in interpret mode here")
+    for engine, fused in candidates:
+        try:
+            solver = build_solver(n_f, nx, nt, widths, fused=fused)
+            train_step, trainables, opt_state = make_sa_step(solver)
+            step = jax.jit(train_step, donate_argnums=(0, 1))
+            t0 = time.time()
+            trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
+            jax.block_until_ready(loss)
+            compile_t = time.time() - t0
+            t0 = time.time()
+            for _ in range(n_steps):
+                trainables, opt_state, loss = step(trainables, opt_state,
+                                                   solver.X_f)
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            pts = n_f * n_steps / dt / n_chips
+            results[engine] = pts
+            log(f"[engines] {engine}: compile {compile_t:.1f}s, "
+                f"{pts:,.0f} pts/sec/chip (loss={float(loss):.4f})")
+        except Exception as e:
+            errors[engine] = f"{type(e).__name__}: {e}"
+            log(f"[engines] {engine} FAILED: {errors[engine]}")
+    return results, errors
 
 
 # --------------------------------------------------------------------------- #
-# --full: real training, time-to-L2
+# --precision: float32(HIGHEST) vs bf16 matmul path on the MXU
+# --------------------------------------------------------------------------- #
+def bench_precision(n_f, nx, nt, widths, n_steps):
+    """Measure the network's dtype/precision knobs (networks.py) as an
+    actual trade-off: throughput + loss drift of each config vs the float32
+    HIGHEST reference."""
+    import jax
+
+    import jax as _jax
+    configs = {
+        "f32-highest": {"precision": _jax.lax.Precision.HIGHEST},
+        "f32-default": {"precision": None},
+        "bf16-matmul": {"dtype": "bfloat16"},
+    }
+    n_chips = max(1, len(_jax.devices())) \
+        if _jax.default_backend() != "cpu" else 1
+    out = {}
+    ref_loss = None
+    for name, kw in configs.items():
+        try:
+            # bf16/precision nets bypass the fused engine (float32-only)
+            solver = build_solver(n_f, nx, nt, widths, fused=False, **kw)
+            train_step, trainables, opt_state = make_sa_step(solver)
+            step = jax.jit(train_step, donate_argnums=(0, 1))
+            trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
+            jax.block_until_ready(loss)
+            t0 = time.time()
+            for _ in range(n_steps):
+                trainables, opt_state, loss = step(trainables, opt_state,
+                                                   solver.X_f)
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            loss = float(loss)
+            if name == "f32-highest":
+                ref_loss = loss
+            out[name] = {"pts_per_sec": n_f * n_steps / dt / n_chips,
+                         "loss": loss,
+                         "loss_drift": (None if ref_loss is None
+                                        else abs(loss - ref_loss))}
+            log(f"[precision] {name}: {out[name]['pts_per_sec']:,.0f} "
+                f"pts/s/chip, loss={loss:.6f}")
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[precision] {name} FAILED: {out[name]['error']}")
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# --full: real training with periodic L2 evaluation -> time-to-target
 # --------------------------------------------------------------------------- #
 def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
-                     adam_iter=10_000, newton_iter=10_000):
+                     adam_iter=10_000, newton_iter=10_000,
+                     eval_every=1_000):
     from tensordiffeq_tpu.exact import allen_cahn_solution
     from tensordiffeq_tpu.helpers import find_L2_error
 
@@ -263,25 +391,49 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
     Xg = np.stack(np.meshgrid(xg, tg, indexing="ij"), -1).reshape(-1, 2)
     u_star = usol.reshape(-1, 1)
 
-    solver = build_solver(n_f, nx, nt, widths)
+    solver = build_solver(n_f, nx, nt, widths, fused="autotune")
+    timeline = []
+    t_target = None
+    Xg_j = None  # device copy, created lazily on first eval
     t0 = time.time()
-    solver.fit(tf_iter=adam_iter, newton_iter=newton_iter)
+
+    # ONE continuous fit: the in-run eval hook fires at chunk boundaries, so
+    # optimizer state, L-BFGS curvature memory, and the compiled runners stay
+    # warm — the wall clock measures a single uninterrupted 10k+10k run (the
+    # rel-L2 eval itself, one forward over the fixture grid per eval_every
+    # epochs, is included; it is negligible next to a training chunk)
+    def eval_fn(phase, step, params):
+        nonlocal t_target, Xg_j
+        import jax.numpy as jnp
+        if Xg_j is None:
+            Xg_j = jnp.asarray(Xg, jnp.float32)
+        u_pred = np.asarray(solver._apply_jit(params, Xg_j))
+        l2 = float(find_L2_error(u_pred, u_star))
+        t = time.time() - t0
+        timeline.append({"t": round(t, 1), "phase": f"{phase}@{step}",
+                         "l2": l2})
+        if t_target is None and l2 <= target:
+            t_target = round(t, 1)
+        log(f"[full] t={t:7.1f}s {phase}@{step}: rel-L2={l2:.3e}")
+
+    solver.fit(tf_iter=adam_iter, newton_iter=newton_iter,
+               eval_fn=eval_fn, eval_every=eval_every)
     wall = time.time() - t0
     u_pred, _ = solver.predict(Xg, best_model=True)
-    l2 = find_L2_error(u_pred, u_star)
-    log(f"[full] wall={wall:.1f}s rel-L2={l2:.3e} (target {target:g})")
-    return wall, float(l2)
+    l2_best = float(find_L2_error(u_pred, u_star))
+    log(f"[full] wall={wall:.1f}s best rel-L2={l2_best:.3e} "
+        f"(target {target:g}, reached at t={t_target})")
+    return {"wall": wall, "l2": l2_best, "t_target": t_target,
+            "timeline": timeline}
 
 
 # --------------------------------------------------------------------------- #
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--full", action="store_true",
-                    help="train AC-SA to convergence and report time-to-L2")
-    ap.add_argument("--engines", action="store_true",
-                    help="compare generic / fused-xla / fused-pallas "
-                         "residual engines on the SA train step")
-    args = ap.parse_args()
+# worker / supervisor
+# --------------------------------------------------------------------------- #
+def worker_main(args):
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     fast = os.environ.get("BENCH_FAST") == "1"
     n_f = int(os.environ.get("BENCH_NF", 2048 if fast else 50_000))
@@ -290,34 +442,142 @@ def main():
     widths = [32, 32] if fast else [128, 128, 128, 128]
 
     if args.engines:
-        results = bench_engines(n_f, nx, nt, widths, n_steps)
+        results, errors = bench_engines(n_f, nx, nt, widths, n_steps)
+        if not results:
+            raise RuntimeError(f"all engines failed: {errors}")
         best = max(results, key=results.get)
-        print(json.dumps({
+        payload = {
             "metric": f"AC-SA step throughput by engine (best: {best})",
             "value": round(results[best]),
             "unit": "collocation-pts/sec/chip",
-            "vs_baseline": round(results[best] / results["generic"], 3),
-        }))
+            "vs_baseline": round(results[best] / results["generic"], 3)
+            if "generic" in results else None,
+            "engines": {k: round(v) for k, v in results.items()},
+        }
+        if errors:
+            payload["engine_errors"] = errors
+    elif args.precision:
+        out = bench_precision(n_f, nx, nt, widths, n_steps)
+        ok = {k: v for k, v in out.items() if "pts_per_sec" in v}
+        if not ok:
+            raise RuntimeError(f"all precision configs failed: {out}")
+        best = max(ok, key=lambda k: ok[k]["pts_per_sec"])
+        ref = ok.get("f32-highest", {}).get("pts_per_sec")
+        payload = {
+            "metric": f"AC-SA step throughput by precision (best: {best})",
+            "value": round(ok[best]["pts_per_sec"]),
+            "unit": "collocation-pts/sec/chip",
+            "vs_baseline": (round(ok[best]["pts_per_sec"] / ref, 3)
+                            if ref else None),
+            "precision": {k: {kk: (round(vv, 6) if isinstance(vv, float)
+                                   else vv) for kk, vv in v.items()}
+                          for k, v in out.items()},
+        }
+    elif args.full:
+        res = bench_time_to_l2(
+            n_f, nx, nt, widths,
+            adam_iter=100 if fast else 10_000,
+            newton_iter=100 if fast else 10_000,
+            eval_every=50 if fast else 1_000)
+        payload = {
+            "metric": "AC-SA wall-clock (10k Adam + 10k L-BFGS) w/ rel-L2",
+            "value": round(res["wall"], 2), "unit": "s",
+            "vs_baseline": res["l2"],  # achieved rel-L2 recorded alongside
+            "rel_l2": res["l2"],
+            "time_to_l2_2.1e-2": res["t_target"],
+            "timeline": res["timeline"],
+        }
+    else:
+        r = bench_jax_throughput(n_f, nx, nt, widths, n_steps)
+        base = get_baseline(n_f, nx, widths, max(3, n_steps // 10))
+        payload = {
+            "metric": "AC SA-PINN training throughput (full minimax step)",
+            "value": round(r["pts_per_sec_per_chip"]),
+            "unit": "collocation-pts/sec/chip",
+            "vs_baseline": (round(r["pts_per_sec_per_chip"] / base, 3)
+                            if base else None),
+            "mfu": (round(r["mfu"], 4) if r["mfu"] is not None else None),
+            "flops_per_step": r["flops_per_step"],
+            "device_kind": r["device_kind"],
+            "backend": r["backend"],
+        }
+    print(json.dumps(payload), flush=True)
+
+
+def run_worker(flags, timeout):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + flags
+    log(f"[supervisor] running {' '.join(cmd)} (timeout {timeout}s)")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, "worker timed out (backend init hang or slow compile)"
+    sys.stderr.write(proc.stderr[-4000:] if proc.stderr else "")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-8:]
+        return None, f"worker rc={proc.returncode}: " + " | ".join(tail)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, "worker produced no JSON line"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="train AC-SA to convergence and report time-to-L2")
+    ap.add_argument("--engines", action="store_true",
+                    help="compare generic / fused-xla / fused-pallas "
+                         "residual engines on the SA train step")
+    ap.add_argument("--precision", action="store_true",
+                    help="compare f32-HIGHEST / f32-default / bf16 network "
+                         "configs")
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--force-cpu", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.worker:
+        worker_main(args)
         return
 
-    if args.full:
-        wall, l2 = bench_time_to_l2(n_f, nx, nt, widths,
-                                    adam_iter=100 if fast else 10_000,
-                                    newton_iter=100 if fast else 10_000)
-        print(json.dumps({
-            "metric": "AC-SA wall-clock to rel-L2 (10k Adam + 10k L-BFGS)",
-            "value": round(wall, 2), "unit": "s",
-            "vs_baseline": l2,  # achieved rel-L2 recorded alongside
-        }))
-        return
+    mode_flags = [f for f in ("--full", "--engines", "--precision")
+                  if getattr(args, f.lstrip("-"))]
+    default_to = 3600 if args.full else 1500
+    timeout_s = int(os.environ.get("BENCH_TIMEOUT", default_to))
 
-    ours = bench_jax_throughput(n_f, nx, nt, widths, n_steps)
-    base = get_baseline(n_f, nx, widths, max(3, n_steps // 10))
-    vs = round(ours / base, 3) if base else 1.0
+    diag = []
+    attempts = [([], timeout_s), ([], min(600, timeout_s))]
+    for i, (flags, to) in enumerate(attempts):
+        payload, err = run_worker(mode_flags + flags, to)
+        if payload is not None:
+            if diag:
+                payload["diag"] = diag
+            print(json.dumps(payload))
+            return
+        diag.append(err)
+        log(f"[supervisor] attempt failed: {err}")
+        if "timed out" in err:
+            # an init hang will hang again — go straight to the CPU fallback
+            break
+
+    log("[supervisor] falling back to CPU measurement")
+    payload, err = run_worker(mode_flags + ["--force-cpu"], timeout_s)
+    if payload is not None:
+        payload["backend_note"] = "cpu-fallback"
+        payload["diag"] = diag
+        print(json.dumps(payload))
+        return
+    diag.append(err)
+
+    # total failure: still honor the one-JSON-line contract, rc=0
     print(json.dumps({
         "metric": "AC SA-PINN training throughput (full minimax step)",
-        "value": round(ours), "unit": "collocation-pts/sec/chip",
-        "vs_baseline": vs,
+        "value": 0, "unit": "collocation-pts/sec/chip",
+        "vs_baseline": None, "diag": diag,
     }))
 
 

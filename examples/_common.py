@@ -7,10 +7,65 @@ windows).  Full-size defaults reproduce the reference configs recorded in
 """
 
 import argparse
+import json
 import os
 import sys
+import tempfile
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PROBE_CACHE = os.path.join(tempfile.gettempdir(), "tdq_backend_probe.json")
+_PROBE_TTL = 600  # seconds
+
+
+def resolve_backend(timeout: int = 120) -> str:
+    """Pin a usable JAX platform *before* first backend use.
+
+    Honours ``TDQ_PLATFORM`` (e.g. ``TDQ_PLATFORM=cpu``); otherwise probes
+    the default backend in a subprocess with a timeout and pins CPU when it
+    is unreachable — on this class of host a TPU tunnel can hang backend
+    init indefinitely, which would otherwise hang every example.  The probe
+    outcome is cached for 10 minutes."""
+    import jax
+
+    want = os.environ.get("TDQ_PLATFORM")
+    if want:
+        jax.config.update("jax_platforms", want)
+        return want
+    already = getattr(jax.config, "jax_platforms", None)
+    if already:  # something (conftest, caller) pinned a platform — keep it
+        return already
+
+    backend = None
+    try:
+        with open(_PROBE_CACHE) as fh:
+            cached = json.load(fh)
+        if time.time() - cached["ts"] < _PROBE_TTL:
+            backend = cached["backend"]
+    except Exception:
+        pass
+    if backend is None:
+        import subprocess
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout)
+            out = (probe.stdout or "").strip().splitlines()
+            backend = out[-1] if probe.returncode == 0 and out else "cpu"
+        except Exception:
+            backend = "cpu"
+        try:
+            with open(_PROBE_CACHE, "w") as fh:
+                json.dump({"ts": time.time(), "backend": backend}, fh)
+        except OSError:
+            pass
+    if backend == "cpu":
+        print("[tdq] default backend unreachable; pinning CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+    return backend
 
 
 def example_args(description: str, flags=(), **extra):
@@ -24,7 +79,9 @@ def example_args(description: str, flags=(), **extra):
     for name, (default, help_) in extra.items():
         ap.add_argument(f"--{name}", type=type(default), default=default,
                         help=help_)
-    return ap.parse_args()
+    args = ap.parse_args()
+    resolve_backend()
+    return args
 
 
 def scaled(args, full: int, quick: int) -> int:

@@ -6,7 +6,15 @@ Learns c1 (diffusion) and c2 (reaction) in
 ``u_t - c1 u_xx + c2 u^3 - c2 u = 0`` from the full 512x201 solution grid,
 optionally with SA collocation weights (``--no-sa`` for the plain variant).
 True values: c1 = 0.0001, c2 = 5.0.
+
+Round-2 promotion demo: the run trains on the fused Taylor residual engine
+(auto-selected with numeric cross-check), checkpoints mid-run, and resumes
+from the checkpoint — state (coefficients, SA weights, Adam moments)
+round-trips exactly.
 """
+
+import os
+import tempfile
 
 import numpy as np
 
@@ -36,10 +44,27 @@ def main():
     col_weights = rng.rand(X.shape[0], 1) if use_sa else None
     widths = [128] * 4 if not args.quick else [32] * 2
 
-    model = DiscoveryModel()
-    model.compile([2, *widths, 1], f_model, [X[:, 0:1], X[:, 1:2]], u_star,
-                  var=[0.0, 0.0], col_weights=col_weights, varnames=["x", "t"])
-    model.fit(tf_iter=scaled(args, 10_000, 300))
+    def build():
+        model = DiscoveryModel()
+        model.compile([2, *widths, 1], f_model,
+                      [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
+                      col_weights=col_weights, varnames=["x", "t"])
+        return model
+
+    total = scaled(args, 10_000, 300)
+    leg = total // 2
+
+    model = build()
+    if model._fused_residual is not None:
+        print("[discovery] fused Taylor residual engine active")
+    model.fit(tf_iter=leg)
+
+    # checkpoint mid-run and resume into a FRESH model (full-state restore)
+    ckpt = os.path.join(tempfile.mkdtemp(), "ac_discovery_ckpt")
+    model.save_checkpoint(ckpt)
+    model = build()
+    model.restore_checkpoint(ckpt)
+    model.fit(tf_iter=total - leg)
 
     c1, c2 = model.vars
     print(f"c1 = {float(c1):.6f} (true 0.0001), c2 = {float(c2):.4f} (true 5.0)")

@@ -75,7 +75,9 @@ class CollocationSolverND:
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
-          layer_sizes: ``[n_in, …, n_out]`` MLP sizes (or pass ``network``).
+          layer_sizes: ``[n_in, …, n_out]`` MLP sizes (or pass ``network``);
+            ``None`` after :meth:`load_model` reuses the loaded architecture
+            and parameters (transfer learning without re-stating the net).
           f_model: per-point residual ``f_model(u, *coords)`` written with
             :func:`tensordiffeq_tpu.grad` combinators.
           domain: :class:`DomainND` with collocation points generated.
@@ -102,6 +104,17 @@ class CollocationSolverND:
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
                              "domain.generate_collocation_points(N_f) first")
+        keep_params = False
+        if layer_sizes is None:
+            # transfer-learn flow: reuse the net+params brought in by
+            # load_model on this (previously uncompiled) solver
+            if not getattr(self, "_loaded", False):
+                raise ValueError(
+                    "layer_sizes=None requires load_model(path) first (the "
+                    "architecture is then taken from the saved file)")
+            layer_sizes = self.layer_sizes
+            network = self.net if network is None else network
+            keep_params = network is self.net
         self.layer_sizes = list(layer_sizes)
         self.domain = domain
         self.bcs = list(bcs)
@@ -116,7 +129,9 @@ class CollocationSolverND:
         self.net = network if network is not None else neural_net(layer_sizes)
         key = jax.random.PRNGKey(self.seed)
         ndim = domain.ndim
-        self.params = self.net.init(key, jnp.zeros((1, ndim), jnp.float32))
+        if not keep_params:
+            self.params = self.net.init(key,
+                                        jnp.zeros((1, ndim), jnp.float32))
         self.apply_fn = self.net.apply
 
         # -- adaptive configuration (reference models.py:68-105) ----------
@@ -201,6 +216,7 @@ class CollocationSolverND:
         from ..ops.taylor import extract_mlp_layers
 
         self._fuse_fail_reason = None
+        self._fuse_requests = None
         # exact type: an MLP subclass may override __call__ (skip
         # connections, feature maps) while keeping Dense params — fusing
         # would silently differentiate a different network
@@ -221,6 +237,7 @@ class CollocationSolverND:
         if requests is None:
             self._fuse_fail_reason = reason
             return None
+        self._fuse_requests = requests
 
         table_producer = None
         if self.fused == "pallas":
@@ -241,7 +258,33 @@ class CollocationSolverND:
         import time as _time
 
         candidates = {"generic": None, "fused": self._fused_residual}
+        if getattr(self, "_fuse_requests", None) is not None:
+            # the VMEM-resident pallas table producer competes too, but only
+            # on real TPU hardware (interpret mode is not a perf candidate)
+            from ..ops import pallas_taylor
+            from ..ops.fused import make_fused_residual
+            from ..ops.taylor import extract_mlp_layers
+            if pallas_taylor.available():
+                layers = extract_mlp_layers(self.params)
+                shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
+                producer = pallas_taylor.build_pallas_table_fn(
+                    self._fuse_requests, shapes,
+                    precision=self.net.precision)
+                pallas_res = make_fused_residual(
+                    self.f_model, self.domain.vars, self.n_out,
+                    self._fuse_requests, precision=self.net.precision,
+                    table_producer=producer)
+                # same guard the XLA fused engine gets: never adopt a
+                # kernel (even a faster one) that disagrees numerically
+                ok, reason = self._crosscheck_fused(residual_fn=pallas_res)
+                if ok:
+                    candidates["pallas"] = pallas_res
+                elif self.verbose:
+                    print(f"[autotune] pallas candidate excluded: failed "
+                          f"numeric cross-check "
+                          f"({type(reason).__name__}: {reason})")
         timings = {}
+        failures = {}
         for name, res_fn in candidates.items():
             loss_fn = build_loss_fn(
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
@@ -255,17 +298,28 @@ class CollocationSolverND:
                                       self.lambdas["residual"], X)[0])(params)
 
             step = jax.jit(value_grad)
-            out = step(self.params, self.X_f)  # compile + warm-up
-            jax.block_until_ready(out)
-            t0 = _time.perf_counter()
-            for _ in range(3):
-                out = step(self.params, self.X_f)
-            jax.block_until_ready(out)
-            timings[name] = (_time.perf_counter() - t0) / 3
+            try:
+                out = step(self.params, self.X_f)  # compile + warm-up
+                jax.block_until_ready(out)
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    out = step(self.params, self.X_f)
+                jax.block_until_ready(out)
+                timings[name] = (_time.perf_counter() - t0) / 3
+            except Exception as e:  # a candidate that cannot even compile
+                # (e.g. Mosaic lowering failure) is excluded, not fatal
+                failures[name] = e
+        if not timings:
+            raise RuntimeError(
+                "autotune: every residual engine candidate failed: "
+                + "; ".join(f"{k}: {type(e).__name__}: {e}"
+                            for k, e in failures.items()))
         best = min(timings, key=timings.get)
         if self.verbose:
             shown = ", ".join(f"{k}={v * 1e3:.2f}ms"
                               for k, v in timings.items())
+            for k, e in failures.items():
+                shown += f", {k}=FAILED({type(e).__name__})"
             print(f"[autotune] residual engine: {best} ({shown})")
         return candidates[best]
 
@@ -278,6 +332,50 @@ class CollocationSolverND:
             lambda pt: self.f_model(u, *(pt[i] for i in range(self.domain.ndim))),
             jax.ShapeDtypeStruct((self.domain.ndim,), jnp.float32))
         return len(out) if isinstance(out, tuple) else 1
+
+    def _crosscheck_fused(self, n_check: int = 32, residual_fn=None):
+        """Numerically compare a fused residual engine against the generic
+        autodiff engine on a small sample of the real collocation set.
+
+        Static analysis (:func:`..ops.fused.analyze_f_model`) can only see
+        how ``u`` is *used*; an f_model that is legal per-point but not
+        pointwise when re-run batched (e.g. ``jnp.mean(u_x(x, t))``,
+        ``jnp.stack([x, t])``-based terms, Python control flow on values)
+        would silently compute a different loss.  One cheap forward of both
+        engines catches every such case — and, for the pallas producer, a
+        wrong-on-hardware kernel.  Returns ``(ok, reason)``."""
+        if residual_fn is None:
+            residual_fn = self._fused_residual
+        X_s = self.X_f[: min(n_check, int(self.X_f.shape[0]))]
+        u = make_ufn(self.apply_fn, self.params, self.domain.vars, self.n_out)
+        generic = vmap_residual(self.f_model, u, self.domain.ndim)(X_s)
+        try:
+            fused = residual_fn(self.params, X_s)
+        except Exception as e:  # e.g. tracer bool error from control flow
+            return False, e
+        gen_t = generic if isinstance(generic, tuple) else (generic,)
+        fus_t = fused if isinstance(fused, tuple) else (fused,)
+        if len(gen_t) != len(fus_t):
+            return False, ValueError(
+                f"fused residual returned {len(fus_t)} component(s), "
+                f"generic returned {len(gen_t)}")
+        for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
+            g_np, f_np = np.asarray(g_c), np.asarray(f_c)
+            if g_np.shape != f_np.shape:
+                return False, ValueError(
+                    f"fused residual component {i} has shape {f_np.shape}, "
+                    f"generic has {g_np.shape}")
+            # the legitimate contraction-order drift between engines stays
+            # ~1e-4 relative (ops/fused.py docstring); a wrong batched
+            # re-interpretation lands far outside this band
+            if not np.allclose(f_np, g_np, rtol=5e-3, atol=1e-5):
+                err = float(np.max(np.abs(f_np - g_np)))
+                return False, ValueError(
+                    f"fused residual disagrees with the generic engine on "
+                    f"{X_s.shape[0]} sample points (component {i}, max abs "
+                    f"diff {err:.3e}); the f_model is likely not pointwise "
+                    "when evaluated batched")
+        return True, None
 
     def _build(self):
         self._fused_residual = self._try_fuse() if self.fused is not False \
@@ -293,8 +391,29 @@ class CollocationSolverND:
                                  f"{type(reason).__name__}: {reason}") \
                     from reason
             raise ValueError(msg)
-        if self.fused == "autotune" and self._fused_residual is not None:
-            self._fused_residual = self._autotune_engine()
+        if self._fused_residual is not None:
+            ok, reason = self._crosscheck_fused()
+            if not ok:
+                if self.fused in (True, "pallas"):
+                    raise ValueError(
+                        "fused residual failed the numeric cross-check "
+                        "against the generic engine") from reason
+                self._fuse_fail_reason = reason
+                self._fused_residual = None
+                if self.verbose:
+                    print(f"[fuse] cross-check failed "
+                          f"({type(reason).__name__}: {reason}); using the "
+                          "generic autodiff engine")
+        if self.fused == "autotune":
+            if self._fused_residual is not None:
+                self._fused_residual = self._autotune_engine()
+            elif self.verbose:
+                reason = getattr(self, "_fuse_fail_reason", None)
+                why = (f"{type(reason).__name__}: {reason}"
+                       if reason is not None else "network is not the "
+                       "standard float32 tanh MLP")
+                print(f"[autotune] fused engine excluded ({why}); only the "
+                      "generic engine was considered")
         self.loss_fn = build_loss_fn(
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
@@ -365,15 +484,30 @@ class CollocationSolverND:
 
     # ------------------------------------------------------------------ #
     def fit(self, tf_iter: int = 0, newton_iter: int = 0,
-            batch_sz: Optional[int] = None, newton_eager: bool = True,
-            chunk: int = 100, profile_dir: Optional[str] = None):
+            batch_sz: Optional[int] = None,
+            newton_eager: Optional[bool] = None,
+            chunk: int = 100, profile_dir: Optional[str] = None,
+            eval_fn: Optional[Callable] = None, eval_every: int = 0):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
-        ``fit.py:17-102``).  ``newton_eager`` is accepted for signature parity
-        but both L-BFGS paths here are on-device jitted loops.
+        ``fit.py:17-102``).
+
+        ``newton_eager`` selects the reference's two L-BFGS flavors
+        (``fit.py:60-89``): ``True`` = the eager loop's *fixed-step* update
+        (lr=0.8, ``optimizers.py:114``), ``False`` = the tfp graph path's
+        strong-Wolfe line search.  Here both run as the same on-device jitted
+        ``lax.scan``; the flag only switches the step rule.  Default ``None``
+        uses the line search (more robust; the fixed-step variant exists for
+        dynamics parity with reference results).
 
         ``profile_dir``: capture an XLA profiler trace of the whole run into
         this directory (first-class version of the reference's commented-out
-        ``tf.profiler`` stubs, ``fit.py:39,57-59`` — SURVEY §5)."""
+        ``tf.profiler`` stubs, ``fit.py:39,57-59`` — SURVEY §5).
+
+        ``eval_fn(phase, step, params)`` + ``eval_every``: periodic in-run
+        evaluation hook (e.g. rel-L2 timelines for time-to-accuracy
+        benchmarks) firing at chunk boundaries of both phases — training
+        state, L-BFGS curvature memory, and compiled runners stay warm, so
+        the measurement is of ONE continuous run."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before fit(...)")
         if profile_dir is not None:
@@ -381,15 +515,19 @@ class CollocationSolverND:
             with trace(profile_dir):
                 return self.fit(tf_iter=tf_iter, newton_iter=newton_iter,
                                 batch_sz=batch_sz, newton_eager=newton_eager,
-                                chunk=chunk)
+                                chunk=chunk, eval_fn=eval_fn,
+                                eval_every=eval_every)
         if self.verbose:
             print_screen(self)
 
+        mesh = None
         if self.dist:
-            from ..parallel import shard_data_inputs
+            from ..parallel import make_mesh, shard_data_inputs
+            mesh = make_mesh()
             # persist the (possibly trimmed) sharded arrays so X_f and
             # per-point λ stay row-consistent across fit()/update_loss() calls
-            self.X_f, self.lambdas = shard_data_inputs(self.X_f, self.lambdas)
+            self.X_f, self.lambdas = shard_data_inputs(self.X_f, self.lambdas,
+                                                       mesh=mesh)
         X_f = self.X_f
         lambdas = self.lambdas
 
@@ -411,7 +549,10 @@ class CollocationSolverND:
                 lr_weights=self.lr_weights, chunk=chunk,
                 verbose=self.verbose, result=result,
                 opt_state=self.opt_state, freeze_lambdas=freeze,
-                lambda_update_fn=self._ntk_fn)
+                lambda_update_fn=self._ntk_fn, mesh=mesh,
+                callback=(None if eval_fn is None else
+                          (lambda e, p: eval_fn("adam", e, p))),
+                callback_every=eval_every)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
             self.best_model["adam"] = result.best_params["adam"]
@@ -422,7 +563,11 @@ class CollocationSolverND:
             from ..training.lbfgs import fit_lbfgs
             params, best_params, best_loss, best_iter, lbfgs_losses = fit_lbfgs(
                 self.loss_fn, self.params, self.lambdas, X_f,
-                maxiter=newton_iter, verbose=self.verbose)
+                maxiter=newton_iter, verbose=self.verbose,
+                eager=bool(newton_eager),
+                callback=(None if eval_fn is None else
+                          (lambda i, p: eval_fn("l-bfgs", i, p))),
+                callback_every=eval_every)
             self.params = params
             self.losses.extend(lbfgs_losses)
             self.best_model["l-bfgs"] = best_params
@@ -448,6 +593,14 @@ class CollocationSolverND:
                   if best_model and self.best_model["overall"] is not None
                   else self.params)
         X_star = jnp.asarray(X_star, jnp.float32)
+        if not self._compiled:
+            if not getattr(self, "_loaded", False):
+                raise RuntimeError("Call compile(...) or load_model(...) "
+                                   "before predict(...)")
+            # loaded-but-uncompiled: the solution net exists, the PDE
+            # residual does not (no f_model yet) — reference load_model
+            # semantics (a bare Keras model, models.py:318-319)
+            return np.asarray(self._apply_jit(params, X_star)), None
         u_star = self._apply_jit(params, X_star)
         f_star = self._residual_jit(params, X_star)
         if isinstance(f_star, tuple):
@@ -475,10 +628,22 @@ class CollocationSolverND:
     def restore_checkpoint(self, path: str):
         """Restore a :meth:`save_checkpoint` state into this (compiled)
         solver.  The solver must be compiled with the same configuration so
-        the state template matches."""
+        the state template matches.
+
+        ``dist=True`` solvers: the collocation set and per-point λ are
+        placed on the device mesh *before* building the template (a
+        checkpoint saved mid-dist-training has the trimmed row count), and
+        the restored λ are re-placed with their ``"data"`` sharding after
+        loading — training resumes sharded, no host-resident λ."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before restore_checkpoint")
         from ..checkpoint import restore_checkpoint
+        mesh = None
+        if self.dist:
+            from ..parallel import make_mesh, shard_data_inputs
+            mesh = make_mesh()
+            self.X_f, self.lambdas = shard_data_inputs(
+                self.X_f, self.lambdas, mesh=mesh)
         template = {"params": self.params, "lambdas": self.lambdas}
         # peek at meta to know whether optimizer moments were saved
         import json as _json
@@ -494,6 +659,12 @@ class CollocationSolverND:
         self.params = state["params"]
         self.lambdas = state["lambdas"]
         self.opt_state = state.get("opt_state")
+        if mesh is not None:
+            # restored λ come back host-resident; re-apply the data-parallel
+            # placement so per-point λ resume sharded alongside their points
+            from ..parallel import shard_data_inputs
+            self.X_f, self.lambdas = shard_data_inputs(
+                self.X_f, self.lambdas, mesh=mesh)
         self.losses = list(meta.get("losses", []))
         for k, v in meta.get("min_loss", {}).items():
             self.min_loss[k] = float(v)
@@ -502,16 +673,80 @@ class CollocationSolverND:
         return self
 
     # ------------------------------------------------------------------ #
+    _SAVE_MAGIC = b"TDQM"
+
+    def _arch_meta(self) -> dict:
+        act = getattr(self.net, "activation", None)
+        return {"format": 1,
+                "layer_sizes": list(self.layer_sizes),
+                "activation": getattr(act, "__name__", str(act)),
+                "network_type": type(self.net).__name__,
+                "n_out": self.n_out}
+
     def save(self, path: str):
-        """Serialise network parameters (reference ``models.py:315-316``).
-        Full training-state checkpoints live in
-        :mod:`tensordiffeq_tpu.checkpoint`."""
+        """Serialise the network — *self-describing*, like the reference's
+        Keras SavedModel (``models.py:315-316``): architecture metadata
+        (layer sizes, activation) is persisted alongside the parameters, so
+        :meth:`load_model` can reconstruct the net without a pre-compiled
+        solver.  Full training-state checkpoints (λ, optimizer moments) live
+        in :mod:`tensordiffeq_tpu.checkpoint`."""
+        import struct
+        header = __import__("json").dumps(self._arch_meta()).encode("utf-8")
         with open(path, "wb") as fh:
-            fh.write(flax.serialization.to_bytes(self.params))
+            fh.write(self._SAVE_MAGIC + struct.pack("<Q", len(header))
+                     + header + flax.serialization.to_bytes(self.params))
 
     def load_model(self, path: str, compile_model: bool = False):
-        """Restore network parameters saved by :meth:`save`
-        (reference ``models.py:318-319``)."""
+        """Restore a network saved by :meth:`save`
+        (reference ``models.py:318-319``).
+
+        On a compiled solver the architecture in the file is validated
+        against the compiled one.  On an *uncompiled* solver the standard
+        MLP is reconstructed from the persisted metadata — no need to
+        re-state ``layer_sizes`` — and a later
+        ``compile(layer_sizes=None, ...)`` reuses the loaded network and
+        parameters (the transfer-learn flow,
+        reference ``examples/transfer-learn.py:56-72``)."""
+        import json as _json
+        import struct
         with open(path, "rb") as fh:
-            self.params = flax.serialization.from_bytes(self.params, fh.read())
+            raw = fh.read()
+        if raw[:4] == self._SAVE_MAGIC:
+            hlen = struct.unpack("<Q", raw[4:12])[0]
+            meta = _json.loads(raw[12:12 + hlen].decode("utf-8"))
+            blob = raw[12 + hlen:]
+        else:  # legacy bare-params file from earlier versions
+            meta, blob = None, raw
+
+        if self._compiled:
+            if meta is not None and (list(meta["layer_sizes"])
+                                     != list(self.layer_sizes)):
+                raise ValueError(
+                    f"saved model has layer_sizes {meta['layer_sizes']} but "
+                    f"this solver was compiled with {self.layer_sizes}")
+            self.params = flax.serialization.from_bytes(self.params, blob)
+            return self
+
+        if meta is None:
+            raise ValueError(
+                "this file has no architecture metadata (saved by an older "
+                "version); compile(...) the solver with the matching "
+                "layer_sizes first, then load_model")
+        if meta.get("network_type") != "MLP" \
+                or "tanh" not in str(meta.get("activation", "")):
+            raise ValueError(
+                f"only the standard tanh MLP can be reconstructed from "
+                f"metadata (file has {meta.get('network_type')}/"
+                f"{meta.get('activation')}); build the custom network "
+                "yourself and compile(..., network=...) before load_model")
+        self.layer_sizes = list(meta["layer_sizes"])
+        self.n_out = int(meta.get("n_out", self.layer_sizes[-1]))
+        self.net = neural_net(self.layer_sizes)
+        template = self.net.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, self.layer_sizes[0]), jnp.float32))
+        self.params = flax.serialization.from_bytes(template, blob)
+        self.apply_fn = self.net.apply
+        self._apply_jit = jax.jit(self.apply_fn)
+        self._loaded = True
         return self

@@ -9,6 +9,18 @@ pytree ``{"params", "vars", "col_weights"}`` routed through a single
 Adam-*ascent* on the SA collocation weights (the ``-grads`` minimax of
 reference ``models.py:369``).
 
+First-class like the forward solver (round-2 promotion):
+
+* ``fused=`` — the residual can run on the stacked Taylor-propagation engine
+  (:mod:`..ops.fused`); the trainable coefficients ride through the batched
+  ``f_model`` re-run as traced scalars, and the engine is numerically
+  cross-checked against the generic per-point autodiff before adoption.
+* ``dist=`` — observation rows (``X``, ``u``, SA ``col_weights``) shard over
+  the ``"data"`` mesh axis; params and coefficients replicate; XLA inserts
+  the ICI all-reduces for the loss means.
+* ``save_checkpoint``/``restore_checkpoint`` — full state (net params,
+  coefficients, SA weights, Adam moments, histories) round-trips.
+
 User contract (JAX-style, per-point)::
 
     def f_model(u, var, x, t):
@@ -46,7 +58,9 @@ class DiscoveryModel:
                 var: Sequence[float], col_weights=None,
                 varnames: Optional[Sequence[str]] = None,
                 lr: float = 0.005, lr_vars: float = 0.005,
-                lr_weights: float = 0.005, seed: int = 0, verbose: bool = True):
+                lr_weights: float = 0.005, seed: int = 0, verbose: bool = True,
+                fused: Optional[bool] = None, dist: bool = False,
+                network=None):
         """Assemble the inverse problem (reference ``models.py:325-341``).
 
         Args:
@@ -61,6 +75,12 @@ class DiscoveryModel:
             gradient ascent — reference ``models.py:348,369``).
           varnames: coordinate names for ``grad(u, "x")`` style authoring
             (defaults to ``x0, x1, …``).
+          fused: residual engine selection, as on the forward solver —
+            ``None`` auto (with numeric cross-check + silent fallback),
+            ``False`` generic, ``True`` require fusion.
+          dist: shard observation rows (and SA col_weights) over all local
+            devices; coefficients and network replicate.
+          network: optional custom Flax module replacing the default MLP.
         """
         if isinstance(X, (list, tuple)):
             X = np.hstack([np.reshape(c, (-1, 1)) for c in X])
@@ -78,8 +98,10 @@ class DiscoveryModel:
                 f"X has {self.ndim} coordinate column(s) but varnames names "
                 f"{len(self.varnames)}: {self.varnames}")
         self.verbose = verbose
+        self.fused = fused
+        self.dist = dist
 
-        self.net = neural_net(layer_sizes)
+        self.net = network if network is not None else neural_net(layer_sizes)
         self.params = self.net.init(jax.random.PRNGKey(seed),
                                     jnp.zeros((1, self.ndim), jnp.float32))
         self.apply_fn = self.net.apply
@@ -90,6 +112,9 @@ class DiscoveryModel:
             "col_weights": (None if col_weights is None
                             else jnp.asarray(col_weights, jnp.float32)),
         }
+
+        if self.dist:
+            self._shard_observations()
 
         def label_fn(tr):
             return {"params": jax.tree_util.tree_map(lambda _: "net", tr["params"]),
@@ -103,8 +128,90 @@ class DiscoveryModel:
              "lam": optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=0.99))},
             label_fn)
         self.opt_state = self.opt.init(self.trainables)
+        self.losses: list[float] = []
+        self.var_history: list[list[float]] = []
         self._build()
         return self
+
+    # ------------------------------------------------------------------ #
+    def _shard_observations(self):
+        """Place observation rows (and SA col_weights) over the "data" mesh
+        axis — data parallelism over the observation/collocation set, the
+        same layout as the forward solver's dist path."""
+        from ..parallel import data_sharding, make_mesh, replicated
+        mesh = make_mesh()
+        n_dev = int(np.prod(mesh.devices.shape))
+        n = int(self.X.shape[0])
+        keep = n - n % n_dev
+        if keep != n and self.verbose:
+            print(f"[discovery] trimming observations {n} -> {keep} to tile "
+                  f"{n_dev} devices")
+        self.X = jax.device_put(self.X[:keep], data_sharding(mesh, 2))
+        self.u_data = jax.device_put(self.u_data[:keep],
+                                     data_sharding(mesh, 2))
+        cw = self.trainables["col_weights"]
+        if cw is not None:
+            self.trainables["col_weights"] = jax.device_put(
+                cw[:keep], data_sharding(mesh, cw.ndim))
+        self.trainables["vars"] = [jax.device_put(v, replicated(mesh))
+                                   for v in self.trainables["vars"]]
+
+    # ------------------------------------------------------------------ #
+    def _try_fuse(self):
+        """Mirror of the forward solver's engine selection for the
+        ``f_model(u, var, *coords)`` contract."""
+        import flax.linen as nn
+
+        from ..networks import MLP
+        from ..ops.fused import analyze_f_model, make_fused_residual
+        from ..ops.taylor import extract_mlp_layers
+
+        self._fuse_fail_reason = None
+        if type(self.net) is not MLP:
+            return None
+        if self.net.activation not in (nn.tanh, jnp.tanh):
+            return None
+        if (self.net.dtype != jnp.float32
+                or self.net.param_dtype != jnp.float32):
+            return None
+        if extract_mlp_layers(self.params) is None:
+            return None
+        var_dummies = [np.float32(np.asarray(v))
+                       for v in self.trainables["vars"]]
+        requests, reason = analyze_f_model(
+            self.f_model, self.varnames, self.n_out, return_reason=True,
+            prefix_args=(var_dummies,))
+        if requests is None:
+            self._fuse_fail_reason = reason
+            return None
+        return make_fused_residual(self.f_model, self.varnames, self.n_out,
+                                   requests, precision=self.net.precision,
+                                   has_prefix_arg=True)
+
+    def _crosscheck_fused(self, n_check: int = 32):
+        X_s = self.X[: min(n_check, int(self.X.shape[0]))]
+        vars0 = self.trainables["vars"]
+        u = make_ufn(self.apply_fn, self.params, self.varnames, self.n_out)
+        generic = vmap_residual(
+            lambda u_, *c: self.f_model(u_, vars0, *c), u, self.ndim)(X_s)
+        try:
+            fused = self._fused_residual(self.params, X_s, vars0)
+        except Exception as e:
+            return False, e
+        gen_t = generic if isinstance(generic, tuple) else (generic,)
+        fus_t = fused if isinstance(fused, tuple) else (fused,)
+        if len(gen_t) != len(fus_t):
+            return False, ValueError(
+                f"fused residual returned {len(fus_t)} component(s), "
+                f"generic returned {len(gen_t)}")
+        for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
+            g_np, f_np = np.asarray(g_c), np.asarray(f_c)
+            if g_np.shape != f_np.shape or not np.allclose(
+                    f_np, g_np, rtol=5e-3, atol=1e-5):
+                return False, ValueError(
+                    f"fused residual disagrees with the generic engine "
+                    f"(component {i})")
+        return True, None
 
     # ------------------------------------------------------------------ #
     def _build(self):
@@ -112,13 +219,42 @@ class DiscoveryModel:
         apply_fn, varnames, n_out = self.apply_fn, self.varnames, self.n_out
         f_model = self.f_model
 
-        def loss_fn(tr):
-            u = make_ufn(apply_fn, tr["params"], varnames, n_out)
-            u_pred = apply_fn(tr["params"], X)
+        self._fused_residual = self._try_fuse() if self.fused is not False \
+            else None
+        if self.fused is True and self._fused_residual is None:
+            reason = getattr(self, "_fuse_fail_reason", None)
+            msg = ("fused=True but the discovery residual cannot be fused "
+                   "(requires the standard float32 tanh MLP and grad() "
+                   "combinators on untransformed coordinates)")
+            if reason is not None:
+                raise ValueError(f"{msg}; analysis stopped on: "
+                                 f"{type(reason).__name__}: {reason}") \
+                    from reason
+            raise ValueError(msg)
+        if self._fused_residual is not None:
+            ok, reason = self._crosscheck_fused()
+            if not ok:
+                if self.fused is True:
+                    raise ValueError(
+                        "fused discovery residual failed the numeric "
+                        "cross-check") from reason
+                self._fuse_fail_reason = reason
+                self._fused_residual = None
+                if self.verbose:
+                    print(f"[fuse] discovery cross-check failed "
+                          f"({type(reason).__name__}); using the generic "
+                          "engine")
+        fused_res = self._fused_residual
 
-            f_pred = vmap_residual(
-                lambda u_, *coords: f_model(u_, tr["vars"], *coords),
-                u, ndim)(X)
+        def loss_fn(tr):
+            u_pred = apply_fn(tr["params"], X)
+            if fused_res is not None:
+                f_pred = fused_res(tr["params"], X, tr["vars"])
+            else:
+                u = make_ufn(apply_fn, tr["params"], varnames, n_out)
+                f_pred = vmap_residual(
+                    lambda u_, *coords: f_model(u_, tr["vars"], *coords),
+                    u, ndim)(X)
             preds = f_pred if isinstance(f_pred, tuple) else (f_pred,)
             data_loss = MSE(u_pred, u_data)
             comps = {"Data": data_loss}
@@ -172,8 +308,6 @@ class DiscoveryModel:
     def train_loop(self, tf_iter: int, chunk: int = 100):
         if self.verbose:
             print_screen(self, discovery_model=True)
-        self.losses: list[float] = []
-        self.var_history: list[list[float]] = []
         t0 = time.time()
         pbar = progress_bar(tf_iter, desc="Discovery") if self.verbose else None
         done = 0
@@ -193,6 +327,39 @@ class DiscoveryModel:
         if pbar is not None:
             pbar.close()
         self.wall_time = time.time() - t0
+
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, path: str):
+        """Full inverse-problem state: net params, coefficient estimates,
+        SA col_weights, Adam moments, loss/coefficient histories."""
+        from ..checkpoint import save_checkpoint
+        state = {"trainables": self.trainables, "opt_state": self.opt_state}
+        meta = {"losses": list(self.losses),
+                "var_history": [list(v) for v in self.var_history]}
+        save_checkpoint(path, state, meta)
+
+    def restore_checkpoint(self, path: str):
+        """Restore a :meth:`save_checkpoint` state into this (compiled)
+        model; under ``dist=True`` the SA col_weights are re-placed on the
+        mesh after loading."""
+        if not hasattr(self, "trainables"):
+            raise RuntimeError("Call compile(...) before restore_checkpoint")
+        from ..checkpoint import restore_checkpoint
+        template = {"trainables": self.trainables,
+                    "opt_state": self.opt_state}
+        state, meta = restore_checkpoint(path, template)
+        self.trainables = state["trainables"]
+        self.opt_state = state["opt_state"]
+        self.losses = list(meta.get("losses", []))
+        self.var_history = [list(v) for v in meta.get("var_history", [])]
+        if self.dist:
+            from ..parallel import data_sharding, make_mesh
+            mesh = make_mesh()
+            cw = self.trainables["col_weights"]
+            if cw is not None:
+                self.trainables["col_weights"] = jax.device_put(
+                    jnp.asarray(cw), data_sharding(mesh, cw.ndim))
+        return self
 
     # ------------------------------------------------------------------ #
     def predict(self, X_star):

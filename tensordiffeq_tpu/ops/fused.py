@@ -5,10 +5,16 @@ The user contract is unchanged — ``f_model(u, x, t)`` written with
 :func:`~tensordiffeq_tpu.grad` combinators.  At compile time the solver runs
 ``f_model`` once against a *symbolic* ``u`` whose ``grad`` applications build
 multi-indices instead of jvp chains; each call site is checked to receive the
-untouched coordinate arguments (object identity), so any nonstandard use —
-evaluating ``u`` at shifted points, transformed coordinates, unsupported
-derivative orders, data-dependent control flow — aborts the analysis and the
-solver silently keeps the generic per-point autodiff engine.
+untouched coordinate arguments (object identity), so evaluating ``u`` at
+shifted points, transformed coordinates, or unsupported derivative orders
+aborts the analysis and the solver silently keeps the generic per-point
+autodiff engine.  This static analysis only sees how ``u`` is *used* — it
+cannot detect f_models that are legal per-point yet not pointwise when re-run
+batched (cross-point reductions like ``jnp.mean(u_x(x, t))``, coordinate
+stacking, Python control flow on values), which is why the solver additionally
+cross-checks the fused residual numerically against the generic engine on a
+small sample before adopting it
+(:meth:`~tensordiffeq_tpu.models.collocation.CollocationSolverND._crosscheck_fused`).
 
 When analysis succeeds and the network is the standard tanh MLP, the batched
 residual becomes: one :func:`~.taylor.taylor_derivatives` wavefront producing
@@ -109,7 +115,8 @@ class SymbolicUFn(UFn):
 
 
 def analyze_f_model(f_model: Callable, varnames: Sequence[str],
-                    n_out: int, return_reason: bool = False):
+                    n_out: int, return_reason: bool = False,
+                    prefix_args: tuple = ()):
     """Dry-run ``f_model`` symbolically.  Returns the set of canonical
     multi-indices it requests, or ``None`` if it isn't fusable.
 
@@ -117,12 +124,16 @@ def analyze_f_model(f_model: Callable, varnames: Sequence[str],
     ``reason`` is the exception that stopped the analysis — an
     :class:`_AbortAnalysis` for structurally-unfusable models, or the user's
     own error (so ``fused=True`` failures can show the real cause instead of
-    a generic "cannot be fused")."""
+    a generic "cannot be fused").
+
+    ``prefix_args`` are passed between ``u`` and the coordinates — the
+    inverse-problem contract ``f_model(u, var, *coords)``
+    (:class:`~tensordiffeq_tpu.models.discovery.DiscoveryModel`)."""
     engine = _AnalysisEngine(len(varnames))
     u = SymbolicUFn(engine, varnames, n_out)
     reason = None
     try:
-        f_model(u, *engine.tokens)
+        f_model(u, *prefix_args, *engine.tokens)
     except _AbortAnalysis as e:
         reason = e
     except Exception as e:
@@ -136,17 +147,23 @@ def analyze_f_model(f_model: Callable, varnames: Sequence[str],
 def make_fused_residual(f_model: Callable, varnames: Sequence[str],
                         n_out: int, requests: set,
                         precision=None,
-                        table_producer: Optional[Callable] = None) -> Callable:
+                        table_producer: Optional[Callable] = None,
+                        has_prefix_arg: bool = False) -> Callable:
     """Build ``residual(params, X) -> [N] | tuple of [N]`` backed by one
     Taylor propagation.  ``params`` must be an
     :func:`~.taylor.extract_mlp_layers`-compatible MLP tree.
 
     ``table_producer(layers, X) -> {mi: [N, n_out]}`` overrides the XLA
     propagation — e.g. the VMEM-resident pallas kernel
-    (:func:`~.pallas_taylor.build_pallas_table_fn`)."""
+    (:func:`~.pallas_taylor.build_pallas_table_fn`).
+
+    ``has_prefix_arg=True`` builds ``residual(params, X, var)`` for the
+    inverse-problem contract ``f_model(u, var, *coords)`` — ``var`` is a
+    traced pytree (the trainable PDE coefficients), multiplying the table
+    lookups like any other batched value."""
     ndim = len(varnames)
 
-    def residual(params, X):
+    def residual(params, X, *prefix):
         layers = extract_mlp_layers(params)
         if layers is None:
             raise ValueError(
@@ -163,6 +180,10 @@ def make_fused_residual(f_model: Callable, varnames: Sequence[str],
         # it would over vmap tracers), so no per-point vmap layer is needed.
         coords = tuple(X[:, i] for i in range(ndim))
         u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
-        return f_model(u, *coords)
+        return f_model(u, *prefix, *coords)
 
+    if not has_prefix_arg:
+        def residual_no_prefix(params, X):
+            return residual(params, X)
+        return residual_no_prefix
     return residual

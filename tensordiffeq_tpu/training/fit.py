@@ -16,6 +16,11 @@ Minibatching scans over pre-reshaped ``[n_batches, bsz, d]`` shards and runs
 **every** batch each epoch (the reference's loop returns after batch 0 —
 SURVEY §2.4.1), and composes with SA weights by gathering λ rows alongside
 their points (lifting the reference restriction at ``models.py:228-229``).
+Under ``dist=True`` the batches are built **per device shard** — each batch
+takes ``bsz / n_dev`` contiguous rows from every device's slice of the
+collocation set, so batching never reshapes across the sharded point axis
+and every batch keeps the global-batch semantics of the reference's
+distributed dataset (``models.py:252-263``).
 """
 
 from __future__ import annotations
@@ -66,6 +71,68 @@ def opt_state_matches(opt, trainables, opt_state) -> bool:
     return all(tuple(np.shape(a)) == tuple(w.shape)
                for a, w in zip(jax.tree_util.tree_leaves(opt_state),
                                jax.tree_util.tree_leaves(want)))
+
+
+def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True):
+    """Slice the collocation set into scan-able batches.
+
+    Returns ``(X_batched [n_b, bsz, d], idx_batched [n_b, bsz], n_batches)``
+    where ``idx_batched`` maps each batch row back to its global point row
+    (for gathering per-point SA λ).
+
+    Single device: contiguous reshape.  With ``mesh`` (data-parallel
+    training): **per-shard batching** — device k owns the contiguous row
+    block ``[k·N/n_dev, (k+1)·N/n_dev)`` of ``X_f`` and λ, and batch b takes
+    rows ``b·bszₗ:(b+1)·bszₗ`` of EVERY device's block (``bszₗ = bsz/n_dev``),
+    so each ``[bsz, d]`` batch is itself sharded over ``"data"``, the λ-row
+    gather stays device-local, and no reshape ever crosses the sharded point
+    axis.  Matches the reference's global-batch semantics
+    (``models.py:252-263``: global batch = per-replica × replicas)."""
+    N_f = int(X_f.shape[0])
+    if batch_sz is None or batch_sz >= N_f:
+        n_batches, bsz = 1, N_f
+    else:
+        n_batches = N_f // batch_sz
+        bsz = batch_sz
+        if mesh is not None:
+            n_dev = int(np.prod(mesh.devices.shape))
+            if bsz % n_dev:
+                orig = bsz
+                bsz = max(bsz - bsz % n_dev, n_dev)
+                n_batches = N_f // bsz
+                if verbose:
+                    print(f"[fit] batch_sz {orig} -> {bsz} so each of "
+                          f"the {n_dev} devices gets equal batch rows")
+        if verbose and n_batches * bsz != N_f:
+            print(f"[fit] dropping {N_f - n_batches * bsz} points so that "
+                  f"{bsz}-point batches tile the collocation set")
+
+    if mesh is not None and n_batches > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import DATA_AXIS
+        n_dev = int(np.prod(mesh.devices.shape))
+        shard_rows = N_f // n_dev
+        bsz_local = bsz // n_dev
+        n_batches = shard_rows // bsz_local
+        idx = np.arange(n_dev * shard_rows).reshape(n_dev, shard_rows)
+        idx = idx[:, : n_batches * bsz_local]
+        idx = idx.reshape(n_dev, n_batches, bsz_local)
+        idx = np.swapaxes(idx, 0, 1).reshape(n_batches, bsz)  # [n_b, bsz]
+        # gather ON DEVICE (a host np.asarray round-trip would both move the
+        # whole set through the host and fail outright on multi-host meshes
+        # where X_f spans non-addressable devices), then place the batch
+        # layout; each device's target rows come from its own shard, so the
+        # reshard is local
+        X_batched = jax.device_put(
+            jnp.take(X_f, jnp.asarray(idx), axis=0),
+            NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        idx_batched = jax.device_put(
+            jnp.asarray(idx), NamedSharding(mesh, P(None, DATA_AXIS)))
+    else:
+        X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
+        idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
+    return X_batched, idx_batched, n_batches
 
 
 @dataclass
@@ -162,22 +229,27 @@ def fit_adam(loss_fn: Callable,
              opt_state: Any = None,
              freeze_lambdas: bool = False,
              lambda_update_fn: Optional[Callable] = None,
+             mesh=None,
+             callback: Optional[Callable] = None,
+             callback_every: int = 0,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
-    training record (losses per epoch, best snapshot)."""
+    training record (losses per epoch, best snapshot).
+
+    ``mesh``: the data-parallel device mesh when ``X_f`` (and per-point λ)
+    are sharded along their leading axis — batches are then built per device
+    shard (see module docstring) instead of by a contiguous reshape, which
+    would split the sharded axis.
+
+    ``callback(epoch, params)`` fires at chunk boundaries whenever the epoch
+    count crosses a multiple of ``callback_every`` — periodic evaluation
+    (e.g. rel-L2 timelines) WITHOUT splitting training into separate fit
+    calls, so the jitted runner and optimizer state stay warm."""
     result = result or FitResult()
     N_f = X_f.shape[0]
-    if batch_sz is None or batch_sz >= N_f:
-        n_batches, bsz = 1, N_f
-    else:
-        n_batches = N_f // batch_sz
-        bsz = batch_sz
-        if verbose and n_batches * bsz != N_f:
-            print(f"[fit] dropping {N_f - n_batches * bsz} points so that "
-                  f"{bsz}-point batches tile the collocation set")
-    X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
-    idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
+    X_batched, idx_batched, n_batches = make_batches(
+        X_f, batch_sz, mesh=mesh, verbose=verbose)
 
     opt = make_optimizer(lr, lr_weights, freeze_lambdas=freeze_lambdas)
     # copy: the chunk runner donates its carried state, and the caller's
@@ -213,9 +285,14 @@ def fit_adam(loss_fn: Callable,
         for e in range(n // n_batches):
             i = (e + 1) * n_batches - 1
             result.losses.append({k: float(v[i]) for k, v in comps.items()})
+        prev_epochs = steps_done // n_batches
         steps_done += n
+        cur_epochs = steps_done // n_batches
         if lambda_update_fn is not None and steps_done < total_steps:
             trainables["lambdas"] = lambda_update_fn(trainables["params"])
+        if (callback is not None and callback_every > 0
+                and prev_epochs // callback_every != cur_epochs // callback_every):
+            callback(cur_epochs, trainables["params"])
         if pbar is not None:
             pbar.update(n // n_batches)
             pbar.set_postfix(loss=result.losses[-1]["Total Loss"])

@@ -36,19 +36,31 @@ from .progress import progress_bar
 def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    memory_size: int = 50, tol_fun: float = 1e-12,
                    tol_grad: float = 1e-12, chunk: int = 100,
-                   verbose: bool = False):
+                   verbose: bool = False, eager: bool = False,
+                   learning_rate: float = 0.8,
+                   callback: Optional[Callable] = None,
+                   callback_every: int = 0):
     """Minimise ``fun(pytree) -> scalar`` with jitted L-BFGS.
 
     Returns ``(x_final, x_best, f_best, best_iter, history)`` where
     ``history`` is the per-iteration loss as a Python list.  Defaults mirror
     the reference's eager L-BFGS (50 correction pairs, ``tolFun=1e-12``,
     ``optimizers.py:114-116``) with a strong-Wolfe zoom line search in place
-    of its fixed 0.8 learning rate.
+    of its fixed 0.8 learning rate; ``eager=True`` keeps the reference's
+    fixed-step rule (``lr=0.8``, ``optimizers.py:114``) for dynamics parity.
     """
-    opt = optax.lbfgs(
-        memory_size=memory_size,
-        linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
-    value_and_grad = optax.value_and_grad_from_state(fun)
+    if eager:
+        opt = optax.lbfgs(learning_rate=learning_rate,
+                          memory_size=memory_size, linesearch=None)
+        plain_vg = jax.value_and_grad(fun)
+
+        def value_and_grad(x, state):
+            return plain_vg(x)
+    else:
+        opt = optax.lbfgs(
+            memory_size=memory_size,
+            linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
+        value_and_grad = optax.value_and_grad_from_state(fun)
 
     @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1, 2))
     def run_chunk(x, state, best, it0, n_steps: int):
@@ -57,15 +69,23 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             value, grad = value_and_grad(x, state=state)
             updates, state = opt.update(grad, state, x, value=value,
                                         grad=grad, value_fn=fun)
-            x = optax.apply_updates(x, updates)
-            new_value = optax.tree.get(state, "value")
+            x_new = optax.apply_updates(x, updates)
+            if eager:
+                # no line-search state to read the post-step value from;
+                # track best at the iterate we just evaluated
+                new_value, x_at = value, x
+            else:
+                new_value = optax.tree.get(state, "value")
+                x_at = x_new
+            x = x_new
 
             x_best, f_best, i_best = best
             # guard: never adopt a NaN/inf iterate as "best"
             improved = jnp.isfinite(new_value) & (new_value < f_best)
             best = (
                 jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(improved, new, old), x, x_best),
+                    lambda new, old: jnp.where(improved, new, old),
+                    x_at, x_best),
                 jnp.where(improved, new_value, f_best),
                 jnp.where(improved, it0 + i, i_best),
             )
@@ -94,7 +114,11 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
         values = np.asarray(values)
         gnorms = np.asarray(gnorms)
         history.extend(float(v) for v in values)
+        prev_done = done
         done += n
+        if (callback is not None and callback_every > 0
+                and prev_done // callback_every != done // callback_every):
+            callback(done, x)
         if pbar is not None:
             pbar.update(n)
             pbar.set_postfix(loss=float(values[-1]))
@@ -115,7 +139,9 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
 
 def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
               maxiter: int = 1000, memory_size: int = 50,
-              verbose: bool = True, chunk: int = 100):
+              verbose: bool = True, chunk: int = 100, eager: bool = False,
+              callback: Optional[Callable] = None,
+              callback_every: int = 0):
     """L-BFGS phase over network params with SA λ frozen
     (reference ``fit.py:60-89``).
 
@@ -131,7 +157,8 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
     t0 = time.time()
     x, x_best, f_best, i_best, history = lbfgs_minimize(
         fun, params, maxiter=maxiter, memory_size=memory_size,
-        chunk=chunk, verbose=verbose)
+        chunk=chunk, verbose=verbose, eager=eager,
+        callback=callback, callback_every=callback_every)
     if verbose:
         print(f"[l-bfgs] {len(history)} iters in {time.time() - t0:.1f}s, "
               f"best loss {float(f_best):.3e} @ iter {int(i_best)}")

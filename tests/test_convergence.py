@@ -1,0 +1,51 @@
+"""Convergence guard: the SURVEY §7 minimum end-to-end slice, as a test.
+
+Trains a small-but-real Burgers PINN (Adam then L-BFGS) and asserts the
+relative L2 error against the Cole-Hopf reference solution drops below
+5e-2 — the accuracy bar of the reference's own examples
+(``/root/reference/examples/burgers-new.py:65-68`` prints exactly this
+metric).  This pins the minimax/L-BFGS *dynamics*, not just the plumbing:
+a silent regression in the optimizer stack or the residual engines shows up
+here as a failed accuracy bound, which "loss decreased" smoke tests cannot
+catch.
+
+Marked slow (minutes on one CPU core): run with ``RUN_SLOW=1 pytest``.
+"""
+
+import numpy as np
+import pytest
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC, grad
+from tensordiffeq_tpu.exact import burgers_solution
+
+
+def build_burgers(n_f, seed=0):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(n_f, seed=seed)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        u_xx = grad(u_x, "x")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
+
+    return domain, bcs, f_model
+
+
+@pytest.mark.slow
+def test_burgers_converges_below_5e2():
+    domain, bcs, f_model = build_burgers(n_f=5_000)
+    solver = CollocationSolverND(verbose=False)
+    solver.compile([2] + [20] * 8 + [1], f_model, domain, bcs)
+    solver.fit(tf_iter=3_000, newton_iter=3_000)
+
+    x, t, usol = burgers_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    assert err < 5e-2, f"Burgers rel-L2 {err:.3e} missed the 5e-2 bar"

@@ -67,3 +67,110 @@ def test_discovery_accepts_stacked_X():
                   varnames=["x", "t"], verbose=False)
     model.fit(tf_iter=10, chunk=10)
     assert len(model.vars) == 1
+
+
+def test_discovery_fused_engine_used_and_matches_generic():
+    """Round-2 promotion: the stacked Taylor engine serves the inverse
+    problem too — coefficients ride through as traced scalars."""
+    x, t, u = synthetic_heat_data(n=128)
+    m_fused = DiscoveryModel()
+    m_fused.compile([2, 12, 12, 1], f_model, [x, t], u, var=[0.3],
+                    varnames=["x", "t"], verbose=False, fused=True)
+    assert m_fused._fused_residual is not None
+    m_gen = DiscoveryModel()
+    m_gen.compile([2, 12, 12, 1], f_model, [x, t], u, var=[0.3],
+                  varnames=["x", "t"], verbose=False, fused=False)
+    lf, _ = m_fused.loss_fn(m_fused.trainables)
+    lg, _ = m_gen.loss_fn(m_gen.trainables)
+    np.testing.assert_allclose(float(lf), float(lg), rtol=1e-4)
+    m_fused.fit(tf_iter=200, chunk=100)
+    m_gen.fit(tf_iter=200, chunk=100)
+    np.testing.assert_allclose(float(m_fused.vars[0]), float(m_gen.vars[0]),
+                               rtol=5e-2, atol=5e-3)
+
+
+def test_discovery_fused_rejects_non_pointwise():
+    import jax.numpy as jnp
+
+    def bad_f(u, var, x, t):
+        return grad(u, "t")(x, t) - var[0] * jnp.mean(grad(u, "x")(x, t))
+
+    x, t, u = synthetic_heat_data(n=64)
+    m = DiscoveryModel()
+    m.compile([2, 8, 1], bad_f, [x, t], u, var=[0.1],
+              varnames=["x", "t"], verbose=False)  # auto mode: falls back
+    assert m._fused_residual is None
+    with pytest.raises(ValueError):
+        DiscoveryModel().compile([2, 8, 1], bad_f, [x, t], u, var=[0.1],
+                                 varnames=["x", "t"], verbose=False,
+                                 fused=True)
+
+
+def test_discovery_dist_shards_and_trains(eight_devices):
+    x, t, u = synthetic_heat_data(n=199)  # 199 -> trimmed to 192 rows
+    cw = np.random.RandomState(1).rand(199, 1)
+    m = DiscoveryModel()
+    m.compile([2, 12, 1], f_model, [x, t], u, var=[0.1], col_weights=cw,
+              varnames=["x", "t"], verbose=False, dist=True)
+    assert m.X.shape[0] == 192
+    assert "data" in str(m.X.sharding.spec)
+    assert "data" in str(m.trainables["col_weights"].sharding.spec)
+    m.fit(tf_iter=100, chunk=50)
+    assert np.isfinite(m.losses[-1])
+    assert "data" in str(m.trainables["col_weights"].sharding.spec)
+
+
+def test_discovery_dist_loss_matches_single_device(eight_devices):
+    x, t, u = synthetic_heat_data(n=192)  # multiple of 8: no trimming
+    m_dist = DiscoveryModel()
+    m_dist.compile([2, 10, 1], f_model, [x, t], u, var=[0.2],
+                   varnames=["x", "t"], verbose=False, dist=True)
+    m_single = DiscoveryModel()
+    m_single.compile([2, 10, 1], f_model, [x, t], u, var=[0.2],
+                     varnames=["x", "t"], verbose=False)
+    ld, _ = m_dist.loss_fn(m_dist.trainables)
+    ls, _ = m_single.loss_fn(m_single.trainables)
+    np.testing.assert_allclose(float(ld), float(ls), rtol=1e-6)
+
+
+def test_discovery_checkpoint_roundtrip(tmp_path):
+    x, t, u = synthetic_heat_data(n=96)
+    cw = np.random.RandomState(1).rand(96, 1)
+    m = DiscoveryModel()
+    m.compile([2, 10, 1], f_model, [x, t], u, var=[0.1], col_weights=cw,
+              varnames=["x", "t"], verbose=False)
+    m.fit(tf_iter=50, chunk=25)
+    m.save_checkpoint(str(tmp_path / "ck"))
+
+    m2 = DiscoveryModel()
+    m2.compile([2, 10, 1], f_model, [x, t], u, var=[0.1], col_weights=cw,
+               varnames=["x", "t"], verbose=False, seed=3)
+    m2.restore_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_allclose(float(m2.vars[0]), float(m.vars[0]), rtol=1e-6)
+    np.testing.assert_allclose(m2.col_weights, m.col_weights, rtol=1e-6)
+    assert len(m2.losses) == len(m.losses)
+    assert len(m2.var_history) == len(m.var_history)
+    # resumed state continues training (moments intact)
+    m2.fit(tf_iter=25, chunk=25)
+    assert len(m2.losses) == len(m.losses) + 25
+
+
+def test_discovery_resume_matches_uninterrupted(tmp_path):
+    x, t, u = synthetic_heat_data(n=96)
+    m_full = DiscoveryModel()
+    m_full.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+                   varnames=["x", "t"], verbose=False)
+    m_full.fit(tf_iter=60, chunk=30)
+
+    m_a = DiscoveryModel()
+    m_a.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+                varnames=["x", "t"], verbose=False)
+    m_a.fit(tf_iter=30, chunk=30)
+    m_a.save_checkpoint(str(tmp_path / "ck"))
+    m_b = DiscoveryModel()
+    m_b.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+                varnames=["x", "t"], verbose=False, seed=5)
+    m_b.restore_checkpoint(str(tmp_path / "ck"))
+    m_b.fit(tf_iter=30, chunk=30)
+    np.testing.assert_allclose(float(m_b.vars[0]), float(m_full.vars[0]),
+                               rtol=1e-4, atol=1e-6)

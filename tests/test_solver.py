@@ -256,3 +256,39 @@ def test_save_load_roundtrip(tmp_path):
     s2.load_model(path)
     u2, _ = s2.predict(X)
     np.testing.assert_allclose(u1, u2, atol=1e-6)
+
+
+def test_eval_fn_hook_fires_in_both_phases():
+    """fit(eval_fn=..., eval_every=...) fires the periodic evaluation hook
+    at chunk boundaries of BOTH phases without splitting the run (the
+    time-to-accuracy harness in bench.py --full builds on this)."""
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    calls = []
+    s.fit(tf_iter=10, newton_iter=10, chunk=5,
+          eval_fn=lambda phase, step, params: calls.append((phase, step)),
+          eval_every=5)
+    phases = {c[0] for c in calls}
+    assert "adam" in phases
+    assert "l-bfgs" in phases
+    adam_steps = [st for ph, st in calls if ph == "adam"]
+    assert adam_steps == [5, 10]
+    # params handed to the hook are usable snapshots
+    seen = []
+    s.fit(tf_iter=5, newton_iter=0, chunk=5,
+          eval_fn=lambda ph, st, p: seen.append(
+              np.asarray(s._apply_jit(p, s.X_f[:4])).shape),
+          eval_every=5)
+    assert seen and seen[0] == (4, 1)
+
+
+def test_eager_newton_matches_reference_fixed_step_mode():
+    """newton_eager=True runs the fixed-step L-BFGS rule (reference
+    optimizers.py:114, lr=0.8) — it must optimize, not no-op."""
+    domain, bcs, f_model = make_burgers()
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs)
+    l0, _ = s.update_loss()
+    s.fit(tf_iter=0, newton_iter=40, newton_eager=True)
+    assert s.min_loss["l-bfgs"] < float(l0)

@@ -1,0 +1,13 @@
+"""Hardware test configuration — REAL backend, no CPU forcing.
+
+Unlike ``tests/`` (which pins an 8-virtual-device CPU mesh so CI never
+needs an accelerator), everything under ``hwtests/`` runs on whatever
+backend JAX picks natively and skips itself when that backend is not a
+TPU.  Run directly:
+
+    python -m pytest hwtests/ -q
+
+This is where on-hardware-only behaviour is guarded: Mosaic lowering of
+the pallas kernels (scatter/batched-matmul restrictions that interpret
+mode does not enforce), scoped-VMEM budgets, and MXU numerics.
+"""

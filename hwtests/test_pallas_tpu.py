@@ -1,0 +1,100 @@
+"""On-hardware pallas parity: the Mosaic-compiled Taylor-table kernels must
+match the XLA engine numerically, forward AND backward, on a real TPU.
+
+Interpret-mode CI (``tests/test_pallas.py``) cannot catch hardware-only
+failures — round 2 found three: ``scatter`` has no Mosaic lowering (the
+one-hot derivative seeds), the batched ``[C, N, in] @ W`` weight-cotangent
+transpose is a double contraction ``tpu.matmul`` rejects, and the backward
+kernel's VJP residuals overflow the ~16 MB scoped-VMEM budget at the
+forward tile size.  These tests pin all three fixes at the AC headline
+shape (2-128x4-1, the reference ``examples/AC-SA.py`` network).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.ops import pallas_taylor
+from tensordiffeq_tpu.ops.taylor import taylor_derivatives
+
+pytestmark = pytest.mark.skipif(
+    not pallas_taylor.available(),
+    reason="real TPU backend required (pallas Mosaic path)")
+
+PREC = jax.lax.Precision.HIGHEST
+SHAPES = [(2, 128), (128, 128), (128, 128), (128, 128), (128, 1)]
+REQS = {(1,), (0, 0)}  # u_t, u_xx — the Allen-Cahn request set
+
+
+def _setup(n=2500, seed=0):
+    rng = np.random.RandomState(seed)
+    layers = [(jnp.asarray(rng.randn(i, o) / np.sqrt(i), jnp.float32),
+               jnp.asarray(rng.randn(o) * 0.01, jnp.float32))
+              for i, o in SHAPES]
+    X = jnp.asarray(rng.rand(n, 2), jnp.float32)
+    return layers, X
+
+
+def test_forward_matches_xla_on_tpu():
+    layers, X = _setup()
+    fn = pallas_taylor.build_pallas_table_fn(REQS, SHAPES, precision=PREC)
+    out = fn(layers, X)
+    ref = taylor_derivatives(layers, X, REQS | {()}, precision=PREC)
+    for mi in out:
+        np.testing.assert_allclose(np.asarray(out[mi]), np.asarray(ref[mi]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_backward_matches_xla_on_tpu():
+    layers, X = _setup()
+    keys = sorted(REQS | {()})
+    fn = pallas_taylor.build_pallas_table_fn(REQS, SHAPES, precision=PREC)
+
+    def loss_pl(ls):
+        t = fn(ls, X)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    def loss_ref(ls):
+        t = taylor_derivatives(ls, X, REQS | {()}, precision=PREC)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    g_pl = jax.grad(loss_pl)(layers)
+    g_ref = jax.grad(loss_ref)(layers)
+    for (gW, gb), (rW, rb) in zip(g_pl, g_ref):
+        scale = float(jnp.max(jnp.abs(rW))) + 1e-8
+        assert float(jnp.max(jnp.abs(gW - rW))) / scale < 1e-5
+        scale = float(jnp.max(jnp.abs(rb))) + 1e-8
+        assert float(jnp.max(jnp.abs(gb - rb))) / scale < 1e-5
+
+
+def test_point_cotangent_matches_on_tpu():
+    """dX through the table (collocation-point adaptation path)."""
+    layers, X = _setup(n=300)
+    keys = sorted(REQS | {()})
+    fn = pallas_taylor.build_pallas_table_fn(REQS, SHAPES, precision=PREC)
+
+    def loss_pl(Xv):
+        t = fn(layers, Xv)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    def loss_ref(Xv):
+        t = taylor_derivatives(layers, Xv, REQS | {()}, precision=PREC)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    gX = jax.grad(loss_pl)(X)
+    rX = jax.grad(loss_ref)(X)
+    scale = float(jnp.max(jnp.abs(rX))) + 1e-8
+    assert float(jnp.max(jnp.abs(gX - rX))) / scale < 1e-5
+
+
+def test_third_order_and_mixed_on_tpu():
+    """KdV-style u_xxx and mixed u_xt lower and match on hardware."""
+    layers, X = _setup(n=500)
+    reqs = {(0, 0, 0), (0, 1)}
+    fn = pallas_taylor.build_pallas_table_fn(reqs, SHAPES, precision=PREC)
+    out = fn(layers, X)
+    ref = taylor_derivatives(layers, X, reqs | {()}, precision=PREC)
+    for mi in out:
+        np.testing.assert_allclose(np.asarray(out[mi]), np.asarray(ref[mi]),
+                                   rtol=1e-5, atol=1e-6)

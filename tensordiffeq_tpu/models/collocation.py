@@ -209,29 +209,15 @@ class CollocationSolverND:
         qualify; ``None`` -> generic per-point engine.  Records the analysis
         failure in ``_fuse_fail_reason`` so ``fused=True`` errors show the
         real cause (e.g. a typo inside the user's f_model)."""
-        import flax.linen as nn
-
-        from ..networks import MLP
-        from ..ops.fused import analyze_f_model, make_fused_residual
+        from ..ops.fused import analyze_f_model, make_fused_residual, \
+            mlp_qualifies
         from ..ops.taylor import extract_mlp_layers
 
         self._fuse_fail_reason = None
         self._fuse_requests = None
-        # exact type: an MLP subclass may override __call__ (skip
-        # connections, feature maps) while keeping Dense params — fusing
-        # would silently differentiate a different network
-        if type(self.net) is not MLP:
-            return None
-        if self.net.activation not in (nn.tanh, jnp.tanh):
-            return None
-        if (self.net.dtype != jnp.float32
-                or self.net.param_dtype != jnp.float32):
-            # the Taylor propagation runs float32; a bf16-configured net
-            # would diverge from the generic engine's numerics
+        if not mlp_qualifies(self.net, self.params):
             return None
         layers = extract_mlp_layers(self.params)
-        if layers is None:
-            return None
         requests, reason = analyze_f_model(
             self.f_model, self.domain.vars, self.n_out, return_reason=True)
         if requests is None:
@@ -344,6 +330,8 @@ class CollocationSolverND:
         would silently compute a different loss.  One cheap forward of both
         engines catches every such case — and, for the pallas producer, a
         wrong-on-hardware kernel.  Returns ``(ok, reason)``."""
+        from ..ops.fused import crosscheck_residuals
+
         if residual_fn is None:
             residual_fn = self._fused_residual
         X_s = self.X_f[: min(n_check, int(self.X_f.shape[0]))]
@@ -353,29 +341,7 @@ class CollocationSolverND:
             fused = residual_fn(self.params, X_s)
         except Exception as e:  # e.g. tracer bool error from control flow
             return False, e
-        gen_t = generic if isinstance(generic, tuple) else (generic,)
-        fus_t = fused if isinstance(fused, tuple) else (fused,)
-        if len(gen_t) != len(fus_t):
-            return False, ValueError(
-                f"fused residual returned {len(fus_t)} component(s), "
-                f"generic returned {len(gen_t)}")
-        for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
-            g_np, f_np = np.asarray(g_c), np.asarray(f_c)
-            if g_np.shape != f_np.shape:
-                return False, ValueError(
-                    f"fused residual component {i} has shape {f_np.shape}, "
-                    f"generic has {g_np.shape}")
-            # the legitimate contraction-order drift between engines stays
-            # ~1e-4 relative (ops/fused.py docstring); a wrong batched
-            # re-interpretation lands far outside this band
-            if not np.allclose(f_np, g_np, rtol=5e-3, atol=1e-5):
-                err = float(np.max(np.abs(f_np - g_np)))
-                return False, ValueError(
-                    f"fused residual disagrees with the generic engine on "
-                    f"{X_s.shape[0]} sample points (component {i}, max abs "
-                    f"diff {err:.3e}); the f_model is likely not pointwise "
-                    "when evaluated batched")
-        return True, None
+        return crosscheck_residuals(generic, fused)
 
     def _build(self):
         self._fused_residual = self._try_fuse() if self.fused is not False \

@@ -160,21 +160,11 @@ class DiscoveryModel:
     def _try_fuse(self):
         """Mirror of the forward solver's engine selection for the
         ``f_model(u, var, *coords)`` contract."""
-        import flax.linen as nn
-
-        from ..networks import MLP
-        from ..ops.fused import analyze_f_model, make_fused_residual
-        from ..ops.taylor import extract_mlp_layers
+        from ..ops.fused import analyze_f_model, make_fused_residual, \
+            mlp_qualifies
 
         self._fuse_fail_reason = None
-        if type(self.net) is not MLP:
-            return None
-        if self.net.activation not in (nn.tanh, jnp.tanh):
-            return None
-        if (self.net.dtype != jnp.float32
-                or self.net.param_dtype != jnp.float32):
-            return None
-        if extract_mlp_layers(self.params) is None:
+        if not mlp_qualifies(self.net, self.params):
             return None
         var_dummies = [np.float32(np.asarray(v))
                        for v in self.trainables["vars"]]
@@ -189,6 +179,8 @@ class DiscoveryModel:
                                    has_prefix_arg=True)
 
     def _crosscheck_fused(self, n_check: int = 32):
+        from ..ops.fused import crosscheck_residuals
+
         X_s = self.X[: min(n_check, int(self.X.shape[0]))]
         vars0 = self.trainables["vars"]
         u = make_ufn(self.apply_fn, self.params, self.varnames, self.n_out)
@@ -198,20 +190,7 @@ class DiscoveryModel:
             fused = self._fused_residual(self.params, X_s, vars0)
         except Exception as e:
             return False, e
-        gen_t = generic if isinstance(generic, tuple) else (generic,)
-        fus_t = fused if isinstance(fused, tuple) else (fused,)
-        if len(gen_t) != len(fus_t):
-            return False, ValueError(
-                f"fused residual returned {len(fus_t)} component(s), "
-                f"generic returned {len(gen_t)}")
-        for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
-            g_np, f_np = np.asarray(g_c), np.asarray(f_c)
-            if g_np.shape != f_np.shape or not np.allclose(
-                    f_np, g_np, rtol=5e-3, atol=1e-5):
-                return False, ValueError(
-                    f"fused residual disagrees with the generic engine "
-                    f"(component {i})")
-        return True, None
+        return crosscheck_residuals(generic, fused)
 
     # ------------------------------------------------------------------ #
     def _build(self):

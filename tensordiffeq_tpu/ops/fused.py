@@ -114,6 +114,55 @@ class SymbolicUFn(UFn):
                            component=self._component)
 
 
+def mlp_qualifies(net, params) -> bool:
+    """True when the network is the exact standard float32 tanh
+    :class:`~tensordiffeq_tpu.networks.MLP` the Taylor propagation can
+    differentiate.  Shared gate for the forward and discovery solvers — an
+    MLP *subclass* may override ``__call__`` while keeping Dense params, and
+    a bf16-configured net would diverge from the generic engine's numerics,
+    so both are excluded."""
+    import flax.linen as nn
+
+    from ..networks import MLP
+    from .taylor import extract_mlp_layers
+
+    return (type(net) is MLP
+            and net.activation in (nn.tanh, jnp.tanh)
+            and net.dtype == jnp.float32
+            and net.param_dtype == jnp.float32
+            and extract_mlp_layers(params) is not None)
+
+
+def crosscheck_residuals(generic, fused):
+    """Compare a fused engine's residual against the generic engine's on the
+    same sample points.  Returns ``(ok, reason)``.
+
+    The legitimate contraction-order drift between engines stays ~1e-4
+    relative (module docstring); a wrong batched re-interpretation (or a
+    wrong-on-hardware pallas kernel) lands far outside the band.  One shared
+    tolerance so the forward and discovery solvers can never drift apart."""
+    gen_t = generic if isinstance(generic, tuple) else (generic,)
+    fus_t = fused if isinstance(fused, tuple) else (fused,)
+    if len(gen_t) != len(fus_t):
+        return False, ValueError(
+            f"fused residual returned {len(fus_t)} component(s), "
+            f"generic returned {len(gen_t)}")
+    for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
+        g_np, f_np = np.asarray(g_c), np.asarray(f_c)
+        if g_np.shape != f_np.shape:
+            return False, ValueError(
+                f"fused residual component {i} has shape {f_np.shape}, "
+                f"generic has {g_np.shape}")
+        if not np.allclose(f_np, g_np, rtol=5e-3, atol=1e-5):
+            err = float(np.max(np.abs(f_np - g_np)))
+            return False, ValueError(
+                f"fused residual disagrees with the generic engine on "
+                f"{g_np.shape[0]} sample points (component {i}, max abs "
+                f"diff {err:.3e}); the f_model is likely not pointwise "
+                "when evaluated batched")
+    return True, None
+
+
 def analyze_f_model(f_model: Callable, varnames: Sequence[str],
                     n_out: int, return_reason: bool = False,
                     prefix_args: tuple = ()):

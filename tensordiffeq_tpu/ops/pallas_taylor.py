@@ -72,7 +72,7 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
 
     def tile_table(layers, x):
         table = taylor_derivatives(list(layers), x, set(mis),
-                                   precision=precision)
+                                   precision=precision, flat_matmul=True)
         return tuple(table[mi] for mi in mis)
 
     # ---------------- forward kernel ----------------
@@ -118,11 +118,17 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
                 dW_ref[...] += gW
                 db_ref[...] += gb
 
+    # the backward kernel re-runs the propagation AND holds its VJP
+    # residuals in VMEM — at the forward tile it blows the ~16 MB scoped
+    # VMEM budget, so it gets a smaller point tile (more grid steps, same
+    # math; the dW accumulation across steps already handles any grid size)
+    tile_bwd = max(128, tile // 4)
+
     def _whole(shape):  # weight-style block: resident across the grid
         return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
 
-    def _tiled(ncols):  # point-axis block
-        return pl.BlockSpec((tile, ncols), lambda i: (i, 0))
+    def _tiled(ncols, t=tile):  # point-axis block
+        return pl.BlockSpec((t, ncols), lambda i: (i, 0))
 
     # biases travel as [1, fan_out]: Mosaic wants >=2-D refs; broadcasting
     # against [tile, fan_out] chunks is unchanged
@@ -131,10 +137,10 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
         w_specs.append(_whole((fan_in, fan_out)))
         w_specs.append(_whole((1, fan_out)))
 
-    def _pad(X):
+    def _pad(X, t=tile):
         N = X.shape[0]
-        n_tiles = -(-N // tile)
-        pad = n_tiles * tile - N
+        n_tiles = -(-N // t)
+        pad = n_tiles * t - N
         if pad:
             X = jnp.concatenate([X, jnp.zeros((pad, X.shape[1]), X.dtype)], 0)
         return X, n_tiles, N
@@ -153,7 +159,7 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
         return tuple(o[:N] for o in outs)
 
     def _backward(flat_layers, X, gs):
-        Xp, n_tiles, N = _pad(X)
+        Xp, n_tiles, N = _pad(X, tile_bwd)
         pad = Xp.shape[0] - N
         if pad:  # zero cotangents on padded rows: no gradient contribution
             gs = tuple(jnp.concatenate(
@@ -161,9 +167,9 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
         outs = pl.pallas_call(
             bwd_kernel,
             grid=(n_tiles,),
-            in_specs=[_tiled(d_in)] + w_specs
-            + [_tiled(n_out) for _ in mis],
-            out_specs=w_specs + [_tiled(d_in)],
+            in_specs=[_tiled(d_in, tile_bwd)] + w_specs
+            + [_tiled(n_out, tile_bwd) for _ in mis],
+            out_specs=w_specs + [_tiled(d_in, tile_bwd)],
             out_shape=[jax.ShapeDtypeStruct(s, X.dtype)
                        for (fi, fo) in layer_shapes
                        for s in ((fi, fo), (1, fo))]

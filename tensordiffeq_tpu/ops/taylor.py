@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 MultiIndex = tuple  # sorted tuple of coordinate indices
@@ -79,7 +80,7 @@ def extract_mlp_layers(params) -> Optional[list]:
 
 
 def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
-                       precision=None) -> dict:
+                       precision=None, flat_matmul: bool = False) -> dict:
     """Evaluate the MLP and all ``requests`` derivatives in one propagation.
 
     Args:
@@ -89,6 +90,12 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
       requests: set of canonical multi-indices (see :func:`supported`).
       precision: matmul precision (pass the network's, e.g. ``HIGHEST``, for
         bit-comparable values with the plain forward pass).
+      flat_matmul: collapse the channel stack into the point axis for each
+        layer matmul (``[C·N, in] @ W`` instead of the batched
+        ``[C, N, in] @ W``).  The pallas kernel body needs this: the batched
+        form's weight-cotangent transpose is a double contraction Mosaic's
+        ``tpu.matmul`` cannot lower.  Keep ``False`` outside kernels — the
+        reshape would cross a GSPMD-sharded point axis under ``dist=True``.
 
     Returns ``{multi_index: [N, n_out] array}`` including the primal ``()``.
     """
@@ -101,7 +108,11 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
     # position (and, under dist training, its sharding — stacking along the
     # sharded axis would make GSPMD gather the batch at every layer).
     Z = X
-    T = {idx: jnp.zeros_like(X).at[:, idx[0]].set(1.0) for idx in firsts}
+    # one-hot via iota-compare, not .at[].set(): scatter has no Mosaic
+    # lowering, and this code also runs inside the pallas kernel body
+    col = jax.lax.broadcasted_iota(jnp.int32, X.shape, 1)
+    T = {idx: jnp.where(col == idx[0], 1.0, 0.0).astype(X.dtype)
+         for idx in firsts}
     S = {idx: jnp.zeros_like(X) for idx in seconds}
     U = {idx: jnp.zeros_like(X) for idx in thirds}
 
@@ -114,7 +125,12 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
             [Z] + [T[i] for i in firsts] + [S[i] for i in seconds]
             + [U[i] for i in thirds], axis=0)  # [C, N, w_in]
         # one (batched) MXU matmul for every channel
-        out = jnp.matmul(stacked, W, precision=precision)
+        if flat_matmul:
+            C = stacked.shape[0]
+            out = jnp.matmul(stacked.reshape(C * N, -1), W,
+                             precision=precision).reshape(C, N, -1)
+        else:
+            out = jnp.matmul(stacked, W, precision=precision)
         chunks = dict(zip(order, out))
         P = chunks[("z", ())] + b
         Q = {i: chunks[("t", i)] for i in firsts}

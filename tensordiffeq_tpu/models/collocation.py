@@ -211,26 +211,28 @@ class CollocationSolverND:
         real cause (e.g. a typo inside the user's f_model)."""
         from ..ops.fused import analyze_f_model, make_fused_residual, \
             mlp_qualifies
-        from ..ops.taylor import extract_mlp_layers
 
         self._fuse_fail_reason = None
         self._fuse_requests = None
-        if not mlp_qualifies(self.net, self.params):
+        self._fuse_shapes = None
+        layers = mlp_qualifies(self.net, self.params)
+        if layers is None:
             return None
-        layers = extract_mlp_layers(self.params)
         requests, reason = analyze_f_model(
             self.f_model, self.domain.vars, self.n_out, return_reason=True)
         if requests is None:
             self._fuse_fail_reason = reason
             return None
         self._fuse_requests = requests
+        # static layer dims, stashed for _autotune_engine's pallas
+        # candidates — one qualification walk serves every consumer
+        self._fuse_shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
 
         table_producer = None
         if self.fused == "pallas":
             from ..ops import pallas_taylor
-            shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
             table_producer = pallas_taylor.build_pallas_table_fn(
-                requests, shapes, precision=self.net.precision,
+                requests, self._fuse_shapes, precision=self.net.precision,
                 interpret=not pallas_taylor.available())
         return make_fused_residual(self.f_model, self.domain.vars, self.n_out,
                                    requests, precision=self.net.precision,
@@ -246,29 +248,45 @@ class CollocationSolverND:
         candidates = {"generic": None, "fused": self._fused_residual}
         if getattr(self, "_fuse_requests", None) is not None:
             # the VMEM-resident pallas table producer competes too, but only
-            # on real TPU hardware (interpret mode is not a perf candidate)
+            # on real TPU hardware (interpret mode is not a perf candidate);
+            # tile size changes the VMEM-residency/pipelining trade-off, so
+            # a few tiles compete as separate candidates
             from ..ops import pallas_taylor
             from ..ops.fused import make_fused_residual
-            from ..ops.taylor import extract_mlp_layers
             if pallas_taylor.available():
-                layers = extract_mlp_layers(self.params)
-                shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
-                producer = pallas_taylor.build_pallas_table_fn(
-                    self._fuse_requests, shapes,
-                    precision=self.net.precision)
-                pallas_res = make_fused_residual(
-                    self.f_model, self.domain.vars, self.n_out,
-                    self._fuse_requests, precision=self.net.precision,
-                    table_producer=producer)
-                # same guard the XLA fused engine gets: never adopt a
-                # kernel (even a faster one) that disagrees numerically
-                ok, reason = self._crosscheck_fused(residual_fn=pallas_res)
-                if ok:
-                    candidates["pallas"] = pallas_res
-                elif self.verbose:
-                    print(f"[autotune] pallas candidate excluded: failed "
-                          f"numeric cross-check "
-                          f"({type(reason).__name__}: {reason})")
+                shapes = self._fuse_shapes
+                # keep tiles strictly smaller than the point set (t == N
+                # would make both training and the cross-check single-block,
+                # and larger is pure padding waste) but always keep at least
+                # one candidate — the kernel pads N < tile correctly
+                tiles = [t for t in (512, 1024, 2048)
+                         if t < int(self.X_f.shape[0])] or [512]
+                # one sample size for every candidate: spans >=2 grid blocks
+                # even for the largest tile AND shares one generic-reference
+                # cache entry across all of them
+                n_chk = max(tiles) + 1
+                for tile in tiles:
+                    producer = pallas_taylor.build_pallas_table_fn(
+                        self._fuse_requests, shapes, tile=tile,
+                        precision=self.net.precision)
+                    pallas_res = make_fused_residual(
+                        self.f_model, self.domain.vars, self.n_out,
+                        self._fuse_requests, precision=self.net.precision,
+                        table_producer=producer)
+                    # same guard the XLA fused engine gets, run PER TILE:
+                    # never adopt a kernel that disagrees numerically.
+                    # Tile-shape-dependent miscompiles are exactly the
+                    # hardware-only bug class interpret mode cannot see;
+                    # n_chk > tile makes the comparison span at least two
+                    # grid blocks, so cross-block accumulation/indexing
+                    # bugs are exercised, not just the first padded block
+                    ok, reason = self._crosscheck_fused(
+                        n_check=n_chk, residual_fn=pallas_res)
+                    if ok:
+                        candidates[f"pallas-{tile}"] = pallas_res
+                    elif self.verbose:
+                        print(f"[autotune] pallas tile={tile} excluded "
+                              f"({type(reason).__name__}: {reason})")
         timings = {}
         failures = {}
         for name, res_fn in candidates.items():
@@ -330,20 +348,56 @@ class CollocationSolverND:
         would silently compute a different loss.  One cheap forward of both
         engines catches every such case — and, for the pallas producer, a
         wrong-on-hardware kernel.  Returns ``(ok, reason)``."""
-        from ..ops.fused import crosscheck_residuals
+        from ..ops.fused import crosscheck_grads, crosscheck_residuals
 
         if residual_fn is None:
             residual_fn = self._fused_residual
-        X_s = self.X_f[: min(n_check, int(self.X_f.shape[0]))]
-        u = make_ufn(self.apply_fn, self.params, self.domain.vars, self.n_out)
-        generic = vmap_residual(self.f_model, u, self.domain.ndim)(X_s)
+        n_s = min(n_check, int(self.X_f.shape[0]))
+        X_s = self.X_f[:n_s]
+
+        def sumsq(out):
+            comps = out if isinstance(out, tuple) else (out,)
+            return sum(jnp.sum(jnp.asarray(c) ** 2) for c in comps)
+
+        # the generic reference (values + gradient) depends only on
+        # (params, n_s), both fixed within one compile — computed once,
+        # shared across every autotune candidate
+        cache = getattr(self, "_crosscheck_cache", None)
+        if cache is None:
+            cache = self._crosscheck_cache = {}
+        if n_s not in cache:
+            u = make_ufn(self.apply_fn, self.params, self.domain.vars,
+                         self.n_out)
+            generic = vmap_residual(self.f_model, u, self.domain.ndim)(X_s)
+
+            def gen_loss(p):
+                u_p = make_ufn(self.apply_fn, p, self.domain.vars,
+                               self.n_out)
+                return sumsq(vmap_residual(self.f_model, u_p,
+                                           self.domain.ndim)(X_s))
+
+            cache[n_s] = (generic, jax.grad(gen_loss)(self.params))
+        generic, g_gen = cache[n_s]
+
         try:
             fused = residual_fn(self.params, X_s)
         except Exception as e:  # e.g. tracer bool error from control flow
             return False, e
-        return crosscheck_residuals(generic, fused)
+        ok, reason = crosscheck_residuals(generic, fused)
+        if not ok:
+            return ok, reason
+
+        # The backward pass gets its own comparison: this round's
+        # hardware-only kernel bugs (PERF.md) were in the backward kernel,
+        # which a forward check never exercises.
+        try:
+            g_fus = jax.grad(lambda p: sumsq(residual_fn(p, X_s)))(self.params)
+        except Exception as e:  # backward-only compile failure
+            return False, e
+        return crosscheck_grads(g_gen, g_fus)
 
     def _build(self):
+        self._crosscheck_cache = {}  # generic reference, per (re)compile
         self._fused_residual = self._try_fuse() if self.fused is not False \
             else None
         if self.fused in (True, "pallas") and self._fused_residual is None:
@@ -411,6 +465,10 @@ class CollocationSolverND:
                                               data_fn=data_fn)
             if data_fn is not None and "data" not in self.lambdas:
                 self.lambdas["data"] = [jnp.ones((), jnp.float32)]
+
+        # the cross-check cache holds param-sized gradient pytrees; it is
+        # only useful within this build pass — release the device memory
+        self._crosscheck_cache = {}
 
     # ------------------------------------------------------------------ #
     def compile_data(self, x, t, y):

@@ -164,7 +164,7 @@ class DiscoveryModel:
             mlp_qualifies
 
         self._fuse_fail_reason = None
-        if not mlp_qualifies(self.net, self.params):
+        if mlp_qualifies(self.net, self.params) is None:
             return None
         var_dummies = [np.float32(np.asarray(v))
                        for v in self.trainables["vars"]]
@@ -174,9 +174,11 @@ class DiscoveryModel:
         if requests is None:
             self._fuse_fail_reason = reason
             return None
+        # return_primal: the data loss evaluates at the same X the residual
+        # does, so u(X) rides the Taylor table — no second network forward
         return make_fused_residual(self.f_model, self.varnames, self.n_out,
                                    requests, precision=self.net.precision,
-                                   has_prefix_arg=True)
+                                   has_prefix_arg=True, return_primal=True)
 
     def _crosscheck_fused(self, n_check: int = 32):
         from ..ops.fused import crosscheck_residuals
@@ -187,10 +189,17 @@ class DiscoveryModel:
         generic = vmap_residual(
             lambda u_, *c: self.f_model(u_, vars0, *c), u, self.ndim)(X_s)
         try:
-            fused = self._fused_residual(self.params, X_s, vars0)
+            fused, u_primal = self._fused_residual(self.params, X_s, vars0)
         except Exception as e:
             return False, e
-        return crosscheck_residuals(generic, fused)
+        ok, reason = crosscheck_residuals(generic, fused)
+        if not ok:
+            return ok, reason
+        # the Data loss consumes the table's primal channel — validate it
+        # against apply_fn too (an f_model that never evaluates u itself
+        # would otherwise leave this path completely unchecked)
+        return crosscheck_residuals(self.apply_fn(self.params, X_s),
+                                    u_primal)
 
     # ------------------------------------------------------------------ #
     def _build(self):
@@ -226,10 +235,12 @@ class DiscoveryModel:
         fused_res = self._fused_residual
 
         def loss_fn(tr):
-            u_pred = apply_fn(tr["params"], X)
             if fused_res is not None:
-                f_pred = fused_res(tr["params"], X, tr["vars"])
+                # primal u(X) comes out of the same Taylor propagation the
+                # residual uses — one network traversal serves both losses
+                f_pred, u_pred = fused_res(tr["params"], X, tr["vars"])
             else:
+                u_pred = apply_fn(tr["params"], X)
                 u = make_ufn(apply_fn, tr["params"], varnames, n_out)
                 f_pred = vmap_residual(
                     lambda u_, *coords: f_model(u_, tr["vars"], *coords),

@@ -114,23 +114,32 @@ class SymbolicUFn(UFn):
                            component=self._component)
 
 
-def mlp_qualifies(net, params) -> bool:
-    """True when the network is the exact standard float32 tanh
-    :class:`~tensordiffeq_tpu.networks.MLP` the Taylor propagation can
-    differentiate.  Shared gate for the forward and discovery solvers — an
-    MLP *subclass* may override ``__call__`` while keeping Dense params, and
-    a bf16-configured net would diverge from the generic engine's numerics,
-    so both are excluded."""
+def mlp_qualifies(net, params):
+    """The extracted ``[(W, b), ...]`` layers when the network is the exact
+    standard float32 tanh :class:`~tensordiffeq_tpu.networks.MLP` the Taylor
+    propagation can differentiate, else ``None``.  Shared gate for the
+    forward and discovery solvers — an MLP *subclass* may override
+    ``__call__`` while keeping Dense params, and a bf16-configured net would
+    diverge from the generic engine's numerics, so both are excluded.
+    Returning the layers (not a bool) keeps qualification and extraction a
+    single tree walk that cannot disagree."""
     import flax.linen as nn
 
     from ..networks import MLP
     from .taylor import extract_mlp_layers
 
-    return (type(net) is MLP
-            and net.activation in (nn.tanh, jnp.tanh)
-            and net.dtype == jnp.float32
-            and net.param_dtype == jnp.float32
-            and extract_mlp_layers(params) is not None)
+    if (type(net) is not MLP
+            or net.activation not in (nn.tanh, jnp.tanh)
+            or net.dtype != jnp.float32
+            or net.param_dtype != jnp.float32):
+        return None
+    return extract_mlp_layers(params)
+
+
+class FusedMismatch(ValueError):
+    """The fused engine's values disagree with the generic engine's beyond
+    the legitimate contraction-order band — the engine is computing
+    different math, not merely failing to compile."""
 
 
 def crosscheck_residuals(generic, fused):
@@ -144,22 +153,44 @@ def crosscheck_residuals(generic, fused):
     gen_t = generic if isinstance(generic, tuple) else (generic,)
     fus_t = fused if isinstance(fused, tuple) else (fused,)
     if len(gen_t) != len(fus_t):
-        return False, ValueError(
+        return False, FusedMismatch(
             f"fused residual returned {len(fus_t)} component(s), "
             f"generic returned {len(gen_t)}")
     for i, (g_c, f_c) in enumerate(zip(gen_t, fus_t)):
         g_np, f_np = np.asarray(g_c), np.asarray(f_c)
         if g_np.shape != f_np.shape:
-            return False, ValueError(
+            return False, FusedMismatch(
                 f"fused residual component {i} has shape {f_np.shape}, "
                 f"generic has {g_np.shape}")
         if not np.allclose(f_np, g_np, rtol=5e-3, atol=1e-5):
             err = float(np.max(np.abs(f_np - g_np)))
-            return False, ValueError(
+            return False, FusedMismatch(
                 f"fused residual disagrees with the generic engine on "
                 f"{g_np.shape[0]} sample points (component {i}, max abs "
                 f"diff {err:.3e}); the f_model is likely not pointwise "
                 "when evaluated batched")
+    return True, None
+
+
+def crosscheck_grads(g_gen, g_fus, rtol: float = 5e-3, atol: float = 1e-5):
+    """Leaf-wise gradient agreement between engines — the backward-pass
+    counterpart of :func:`crosscheck_residuals`, sharing one tolerance
+    policy.  Returns ``(ok, reason)``."""
+    gen_leaves = jax.tree_util.tree_leaves(g_gen)
+    fus_leaves = jax.tree_util.tree_leaves(g_fus)
+    if len(gen_leaves) != len(fus_leaves):
+        return False, FusedMismatch(
+            f"gradient trees have {len(fus_leaves)} vs {len(gen_leaves)} "
+            "leaves")
+    for lg, lf in zip(gen_leaves, fus_leaves):
+        lg, lf = np.asarray(lg), np.asarray(lf)
+        scale = float(np.max(np.abs(lg))) + atol
+        err = float(np.max(np.abs(lf - lg)))
+        if err / scale > rtol:
+            return False, FusedMismatch(
+                f"fused residual GRADIENT disagrees with the generic "
+                f"engine (relative error {err / scale:.3e} on a parameter "
+                f"leaf); the engine's backward pass is wrong")
     return True, None
 
 
@@ -197,7 +228,8 @@ def make_fused_residual(f_model: Callable, varnames: Sequence[str],
                         n_out: int, requests: set,
                         precision=None,
                         table_producer: Optional[Callable] = None,
-                        has_prefix_arg: bool = False) -> Callable:
+                        has_prefix_arg: bool = False,
+                        return_primal: bool = False) -> Callable:
     """Build ``residual(params, X) -> [N] | tuple of [N]`` backed by one
     Taylor propagation.  ``params`` must be an
     :func:`~.taylor.extract_mlp_layers`-compatible MLP tree.
@@ -209,7 +241,12 @@ def make_fused_residual(f_model: Callable, varnames: Sequence[str],
     ``has_prefix_arg=True`` builds ``residual(params, X, var)`` for the
     inverse-problem contract ``f_model(u, var, *coords)`` — ``var`` is a
     traced pytree (the trainable PDE coefficients), multiplying the table
-    lookups like any other batched value."""
+    lookups like any other batched value.
+
+    ``return_primal=True`` returns ``(residual, u)`` with ``u = table[()]``
+    — the propagation always computes the primal, so a caller whose data
+    loss evaluates at the SAME ``X`` (the discovery solver) saves one full
+    network forward per step by taking it from here instead of ``apply_fn``."""
     ndim = len(varnames)
 
     def residual(params, X, *prefix):
@@ -229,7 +266,10 @@ def make_fused_residual(f_model: Callable, varnames: Sequence[str],
         # it would over vmap tracers), so no per-point vmap layer is needed.
         coords = tuple(X[:, i] for i in range(ndim))
         u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
-        return f_model(u, *prefix, *coords)
+        out = f_model(u, *prefix, *coords)
+        if return_primal:
+            return out, table[()]
+        return out
 
     if not has_prefix_arg:
         def residual_no_prefix(params, X):

@@ -39,8 +39,8 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    verbose: bool = False, eager: bool = False,
                    learning_rate: float = 0.8,
                    callback: Optional[Callable] = None,
-                   callback_every: int = 0):
-    """Minimise ``fun(pytree) -> scalar`` with jitted L-BFGS.
+                   callback_every: int = 0, args: tuple = ()):
+    """Minimise ``fun(pytree, *args) -> scalar`` with jitted L-BFGS.
 
     Returns ``(x_final, x_best, f_best, best_iter, history)`` where
     ``history`` is the per-iteration loss as a Python list.  Defaults mirror
@@ -48,27 +48,41 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     ``optimizers.py:114-116``) with a strong-Wolfe zoom line search in place
     of its fixed 0.8 learning rate; ``eager=True`` keeps the reference's
     fixed-step rule (``lr=0.8``, ``optimizers.py:114``) for dynamics parity.
+
+    ``args`` (problem data: collocation points, frozen λ) are threaded into
+    the jitted chunk as traced inputs, NOT closed over — closing over a
+    global sharded array is illegal under multi-host
+    (``jax.distributed``-initialized) execution, where each process only
+    addresses its own shard.
     """
     if eager:
         opt = optax.lbfgs(learning_rate=learning_rate,
                           memory_size=memory_size, linesearch=None)
-        plain_vg = jax.value_and_grad(fun)
-
-        def value_and_grad(x, state):
-            return plain_vg(x)
     else:
         opt = optax.lbfgs(
             memory_size=memory_size,
             linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
-        value_and_grad = optax.value_and_grad_from_state(fun)
 
     @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1, 2))
-    def run_chunk(x, state, best, it0, n_steps: int):
+    def run_chunk(x, state, best, it0, fn_args, n_steps: int):
+        # bind the traced data refs: a closure over *tracers* is fine, it is
+        # the device-array closure that breaks multi-host
+        def fun_local(p):
+            return fun(p, *fn_args)
+
+        if eager:
+            plain_vg = jax.value_and_grad(fun_local)
+
+            def value_and_grad(x, state):
+                return plain_vg(x)
+        else:
+            value_and_grad = optax.value_and_grad_from_state(fun_local)
+
         def step(carry, i):
             x, state, best = carry
             value, grad = value_and_grad(x, state=state)
             updates, state = opt.update(grad, state, x, value=value,
-                                        grad=grad, value_fn=fun)
+                                        grad=grad, value_fn=fun_local)
             x_new = optax.apply_updates(x, updates)
             if eager:
                 # no line-search state to read the post-step value from;
@@ -110,7 +124,7 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     while done < maxiter:
         n = int(min(chunk, maxiter - done))
         x, state, best, values, gnorms = run_chunk(
-            x, state, best, jnp.asarray(done), n)
+            x, state, best, jnp.asarray(done), args, n)
         values = np.asarray(values)
         gnorms = np.asarray(gnorms)
         history.extend(float(v) for v in values)
@@ -151,14 +165,17 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
     lam_res = lambdas["residual"]
     lam_data = lambdas.get("data", (None,))[0]
 
-    def fun(p):
+    # data rides `args` (traced chunk inputs), never a closure: required for
+    # multi-host, where X_f/λ span devices this process cannot address
+    def fun(p, lam_bcs, lam_res, X_f, lam_data):
         return loss_fn(p, lam_bcs, lam_res, X_f, lam_data=lam_data)[0]
 
     t0 = time.time()
     x, x_best, f_best, i_best, history = lbfgs_minimize(
         fun, params, maxiter=maxiter, memory_size=memory_size,
         chunk=chunk, verbose=verbose, eager=eager,
-        callback=callback, callback_every=callback_every)
+        callback=callback, callback_every=callback_every,
+        args=(lam_bcs, lam_res, X_f, lam_data))
     if verbose:
         print(f"[l-bfgs] {len(history)} iters in {time.time() - t0:.1f}s, "
               f"best loss {float(f_best):.3e} @ iter {int(i_best)}")

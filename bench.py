@@ -80,7 +80,7 @@ _UNSET = object()
 
 
 def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
-                 precision=_UNSET):
+                 precision=_UNSET, fused_dtype=None):
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, grad, periodicBC
 
@@ -122,7 +122,7 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
         dict_adaptive={"residual": [True], "BCs": [True, False]},
         init_weights={"residual": [rng.rand(n_f, 1)],
                       "BCs": [100.0 * rng.rand(nx, 1), None]},
-        fused=fused, network=network)
+        fused=fused, network=network, fused_dtype=fused_dtype)
     return solver
 
 
@@ -346,6 +346,10 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
         "f32-highest": {"precision": jax.lax.Precision.HIGHEST},
         "f32-default": {"precision": None},
         "bf16-matmul": {"dtype": "bfloat16"},
+        # mixed-precision fused Taylor engine: bf16 matmul operands with
+        # f32 accumulation inside the derivative propagation (the network
+        # itself stays f32) — the MXU-native path for the PINN hot loop
+        "bf16-taylor": {"fused": True, "fused_dtype": "bfloat16"},
     }
     # single-device solvers (no dist=True): per-chip == measured
     n_chips = 1
@@ -353,8 +357,12 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
     ref_loss = None
     for name, kw in configs.items():
         try:
-            # bf16/precision nets bypass the fused engine (float32-only)
-            solver = build_solver(n_f, nx, nt, widths, fused=False, **kw)
+            # bf16/precision nets bypass the fused engine (float32-only);
+            # the bf16-taylor config instead keeps the f32 net and lowers
+            # the fused engine's matmuls
+            kw = dict(kw)
+            kw.setdefault("fused", False)
+            solver = build_solver(n_f, nx, nt, widths, **kw)
             train_step, trainables, opt_state = make_sa_step(solver)
             step = jax.jit(train_step, donate_argnums=(0, 1))
             trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)

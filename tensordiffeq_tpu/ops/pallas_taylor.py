@@ -53,7 +53,7 @@ def available() -> bool:
 
 def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
                           tile: int = 1024, precision=None,
-                          interpret: bool = False):
+                          interpret: bool = False, compute_dtype=None):
     """Build ``table_fn(layers, X) -> {mi: [N, n_out]}`` backed by the fused
     pallas kernels.
 
@@ -64,6 +64,9 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
         ``tile × width × channels × layers``).
       precision: matmul precision inside the kernel.
       interpret: run in interpreter mode (CPU testing).
+      compute_dtype: mixed-precision matmul operands inside the kernel
+        (e.g. ``jnp.bfloat16`` for the MXU's native single-pass path) with
+        float32 accumulation; see :func:`~.taylor.taylor_derivatives`.
     """
     mis = _sorted_mis(requests)
     n_layers = len(layer_shapes)
@@ -72,7 +75,8 @@ def build_pallas_table_fn(requests: set, layer_shapes: Sequence[tuple],
 
     def tile_table(layers, x):
         table = taylor_derivatives(list(layers), x, set(mis),
-                                   precision=precision, flat_matmul=True)
+                                   precision=precision, flat_matmul=True,
+                                   compute_dtype=compute_dtype)
         return tuple(table[mi] for mi in mis)
 
     # ---------------- forward kernel ----------------

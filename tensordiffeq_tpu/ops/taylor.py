@@ -23,6 +23,7 @@ positions, e.g. ``()`` primal, ``(0,)`` = u_x, ``(0, 1)`` = u_xt,
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -80,7 +81,8 @@ def extract_mlp_layers(params) -> Optional[list]:
 
 
 def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
-                       precision=None, flat_matmul: bool = False) -> dict:
+                       precision=None, flat_matmul: bool = False,
+                       compute_dtype=None) -> dict:
     """Evaluate the MLP and all ``requests`` derivatives in one propagation.
 
     Args:
@@ -96,6 +98,14 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
         form's weight-cotangent transpose is a double contraction Mosaic's
         ``tpu.matmul`` cannot lower.  Keep ``False`` outside kernels — the
         reshape would cross a GSPMD-sharded point axis under ``dist=True``.
+      compute_dtype: mixed-precision matmul inputs (e.g. ``jnp.bfloat16``):
+        the layer matmuls cast their operands to this dtype and accumulate
+        in float32 (``preferred_element_type``), putting the MXU's native
+        single-pass bf16 path under the propagation; every pointwise op
+        (tanh chain rules, channel products) stays float32.  ``None`` keeps
+        full-precision matmuls governed by ``precision``.  An accuracy
+        trade-off the caller must opt into — derivatives through tanh are
+        precision-sensitive.
 
     Returns ``{multi_index: [N, n_out] array}`` including the primal ``()``.
     """
@@ -125,12 +135,17 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
             [Z] + [T[i] for i in firsts] + [S[i] for i in seconds]
             + [U[i] for i in thirds], axis=0)  # [C, N, w_in]
         # one (batched) MXU matmul for every channel
-        if flat_matmul:
-            C = stacked.shape[0]
-            out = jnp.matmul(stacked.reshape(C * N, -1), W,
-                             precision=precision).reshape(C, N, -1)
+        if compute_dtype is not None:
+            lhs, rhs = stacked.astype(compute_dtype), W.astype(compute_dtype)
+            mm = partial(jnp.matmul, preferred_element_type=jnp.float32)
         else:
-            out = jnp.matmul(stacked, W, precision=precision)
+            lhs, rhs = stacked, W
+            mm = partial(jnp.matmul, precision=precision)
+        if flat_matmul:
+            C = lhs.shape[0]
+            out = mm(lhs.reshape(C * N, -1), rhs).reshape(C, N, -1)
+        else:
+            out = mm(lhs, rhs)
         chunks = dict(zip(order, out))
         P = chunks[("z", ())] + b
         Q = {i: chunks[("t", i)] for i in firsts}

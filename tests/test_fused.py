@@ -280,3 +280,110 @@ def test_solver_autotune_selects_an_engine():
     assert np.isfinite(float(total))
     s.fit(tf_iter=4, newton_iter=0, chunk=2)
     assert np.isfinite(s.losses[-1]["Total Loss"])
+
+
+def test_fused_dtype_bf16_engine_trains_and_stays_in_band():
+    """fused_dtype='bfloat16': mixed-precision Taylor matmuls (bf16 operands,
+    f32 accumulation) stay within the widened cross-check band of the f32
+    generic engine and the solver still trains."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC
+    from tensordiffeq_tpu.ops.derivatives import make_ufn, vmap_residual
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(256, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return grad(u, "t")(x, t) + u(x, t) * u_x(x, t) \
+            - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=0)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, fused=True,
+              fused_dtype="bfloat16")
+    assert s._fused_residual is not None
+
+    # residual values: bf16 matmuls drift beyond f32 round-off but must stay
+    # within the documented mixed-precision band vs the generic engine
+    u = make_ufn(s.apply_fn, s.params, s.domain.vars, s.n_out)
+    generic = np.asarray(vmap_residual(f_model, u, 2)(s.X_f))
+    fused = np.asarray(s._fused_residual(s.params, s.X_f))
+    scale = np.max(np.abs(generic)) + 1e-3
+    assert np.max(np.abs(fused - generic)) / scale < 5e-2
+
+    s.fit(tf_iter=6, newton_iter=0, chunk=3)
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+    assert s.losses[-1]["Total Loss"] < s.losses[0]["Total Loss"]
+
+
+def test_fused_dtype_ignored_with_generic_engine():
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+    bcs = [IC(domain, [lambda x: 0.0 * x], var=[["x"]])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    s = CollocationSolverND(verbose=False)
+    with pytest.warns(UserWarning, match="fused_dtype is ignored"):
+        s.compile([2, 8, 1], f_model, domain, bcs, fused=False,
+                  fused_dtype="bfloat16")
+    assert s.fused_dtype is None
+
+
+def test_fused_dtype_lbfgs_uses_full_precision_engine():
+    """Under fused_dtype, the Newton phase's loss (loss_fn_refine) is a
+    separate full-precision engine — L-BFGS line searches cannot survive
+    bf16 gradient noise."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, dirichletBC
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(256, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return grad(u, "t")(x, t) + u(x, t) * u_x(x, t) \
+            - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=0)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, fused=True,
+              fused_dtype="bfloat16")
+    assert s.loss_fn_refine is not s.loss_fn
+
+    t_bf16, _ = s.loss_fn(s.params, s.lambdas["BCs"], s.lambdas["residual"],
+                          s.X_f)
+    t_f32, _ = s.loss_fn_refine(s.params, s.lambdas["BCs"],
+                                s.lambdas["residual"], s.X_f)
+    assert np.isfinite(float(t_bf16)) and np.isfinite(float(t_f32))
+
+    s.fit(tf_iter=4, newton_iter=4, chunk=2)
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+
+
+def test_fused_dtype_without_fused_engine_refine_alias():
+    """No fused engine (f32 default): loss_fn_refine is the same object."""
+    from tensordiffeq_tpu import IC, CollocationSolverND, DomainND
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+    bcs = [IC(domain, [lambda x: 0.0 * x], var=[["x"]])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs)
+    assert s.loss_fn_refine is s.loss_fn

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-2 TPU evidence queue: run the full measurement suite the moment the
+# TPU tunnel is healthy.  Each step is independent; artifacts land in
+# runs/ and BENCH_TPU_*.json at the repo root.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+
+echo "=== 0. health check ==="
+timeout 90 python -c "import jax; print(jax.devices())" || exit 1
+
+echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
+BENCH_TIMEOUT=5400 timeout 5500 python bench.py --full \
+    > BENCH_TPU_full.json 2> runs/ac_sa_full_tpu.log
+tail -1 BENCH_TPU_full.json
+
+echo "=== 2. headline throughput (autotune now includes pallas) ==="
+timeout 1800 python bench.py > BENCH_TPU_default.json 2> runs/bench_default_tpu.log
+tail -1 BENCH_TPU_default.json
+
+echo "=== 3. precision axis (incl bf16-taylor) ==="
+timeout 2500 python bench.py --precision > BENCH_TPU_precision.json 2> runs/bench_precision_tpu.log
+tail -1 BENCH_TPU_precision.json
+
+echo "=== 4. engines ==="
+timeout 1800 python bench.py --engines > BENCH_TPU_engines.json 2> runs/bench_engines_tpu.log
+tail -1 BENCH_TPU_engines.json
+
+echo "=== 5. on-hardware kernel parity tests ==="
+timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log
+
+echo "ALL TPU EVIDENCE CAPTURED"

@@ -28,6 +28,8 @@ Modes:
   (default)     SA train-step throughput + MFU
   --engines     generic vs fused-XLA vs fused-pallas residual engines
   --precision   float32(HIGHEST) vs bf16-matmul network forward config
+  --scale       single-chip throughput sweep over N_f 50k..500k (500k is
+                the reference's AC-dist-new.py multi-GPU config)
   --full        train AC-SA for real (Adam + L-BFGS) with periodic L2
                 evaluation; reports wall-clock to rel-L2 <= 2.1e-2 (the
                 SA-PINN paper figure cited at reference ``models.py:37``)
@@ -389,6 +391,63 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
 
 
 # --------------------------------------------------------------------------- #
+# --scale: single-chip throughput vs collocation-point count
+# --------------------------------------------------------------------------- #
+def bench_scale(nx, nt, widths, n_steps, n_f_list=None, on_point=None):
+    """Sweep N_f up to the reference's *distributed* config (AC-dist-new.py:
+    N_f=500k, which the reference needs a multi-GPU MirroredStrategy for)
+    and measure single-chip SA-step throughput + MFU at each size.
+
+    ``on_point(out)`` fires after every completed point so the worker can
+    stream partial payloads — a timeout on a later (larger) point must not
+    discard measurements already taken."""
+    fast = os.environ.get("BENCH_FAST") == "1"
+    if n_f_list is None:
+        if fast:
+            n_f_list = [2048, 4096]
+        else:
+            import jax
+            # the full sweep is a TPU measurement; the CPU fallback keeps
+            # only sizes it can finish inside the worker budget
+            n_f_list = ([10_000, 50_000] if jax.default_backend() == "cpu"
+                        else [50_000, 125_000, 250_000, 500_000])
+    out = {}
+    for n_f in n_f_list:
+        steps = max(10, n_steps * n_f_list[0] // n_f)
+        try:
+            r = bench_jax_throughput(n_f, nx, nt, widths, steps)
+            out[str(n_f)] = {"pts_per_sec": round(r["pts_per_sec_per_chip"]),
+                             "mfu": (round(r["mfu"], 4)
+                                     if r["mfu"] is not None else None)}
+        except Exception as e:
+            out[str(n_f)] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[scale] N_f={n_f} FAILED: {out[str(n_f)]['error']}")
+        if on_point is not None:
+            on_point(dict(out))
+    return out
+
+
+def scale_payload(out):
+    """Payload for a (possibly partial) --scale sweep.  The multi-GPU
+    comparison claim is only made when the 500k point — the size the
+    reference's AC-dist-new.py needs MirroredStrategy for — actually ran."""
+    ok = {k: v for k, v in out.items() if "pts_per_sec" in v}
+    if not ok:
+        return None
+    top = max(ok, key=lambda k: int(k))
+    note = (" (the size the reference needs multi-GPU for)"
+            if int(top) >= 500_000 else "")
+    return {
+        "metric": f"AC-SA single-chip throughput at N_f={top}{note}",
+        "value": ok[top]["pts_per_sec"],
+        "unit": "collocation-pts/sec/chip",
+        "vs_baseline": None,
+        "mfu": ok[top]["mfu"],
+        "scale": out,
+    }
+
+
+# --------------------------------------------------------------------------- #
 # --full: real training with periodic L2 evaluation -> time-to-target
 # --------------------------------------------------------------------------- #
 def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
@@ -483,6 +542,19 @@ def worker_main(args):
                                    else vv) for kk, vv in v.items()}
                           for k, v in out.items()},
         }
+    elif args.scale:
+        # stream a payload line per completed point: if a later, larger
+        # point hangs past the supervisor timeout, the salvage path in
+        # run_worker still recovers everything measured so far
+        def on_point(partial):
+            p = scale_payload(partial)
+            if p is not None:
+                print(json.dumps(p), flush=True)
+
+        out = bench_scale(nx, nt, widths, n_steps, on_point=on_point)
+        payload = scale_payload(out)
+        if payload is None:
+            raise RuntimeError(f"all scale points failed: {out}")
     elif args.full:
         res = bench_time_to_l2(
             n_f, nx, nt, widths,
@@ -520,7 +592,22 @@ def run_worker(flags, timeout):
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout, cwd=REPO)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # salvage streamed partial payloads (e.g. --scale prints one line
+        # per completed sweep point) before declaring the attempt dead
+        partial = e.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        for line in reversed(partial.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                payload["partial"] = ("worker timed out after this "
+                                      "measurement; later points lost")
+                return payload, None
         return None, "worker timed out (backend init hang or slow compile)"
     sys.stderr.write(proc.stderr[-4000:] if proc.stderr else "")
     if proc.returncode != 0:
@@ -546,6 +633,9 @@ def main():
     ap.add_argument("--precision", action="store_true",
                     help="compare f32-HIGHEST / f32-default / bf16 network "
                          "configs")
+    ap.add_argument("--scale", action="store_true",
+                    help="single-chip throughput sweep over N_f up to 500k "
+                         "(the reference's multi-GPU config)")
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--force-cpu", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -554,9 +644,9 @@ def main():
         worker_main(args)
         return
 
-    mode_flags = [f for f in ("--full", "--engines", "--precision")
+    mode_flags = [f for f in ("--full", "--engines", "--precision", "--scale")
                   if getattr(args, f.lstrip("-"))]
-    default_to = 3600 if args.full else 1500
+    default_to = 3600 if args.full else (3000 if args.scale else 1500)
     timeout_s = int(os.environ.get("BENCH_TIMEOUT", default_to))
 
     diag = []

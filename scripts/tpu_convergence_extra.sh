@@ -1,0 +1,26 @@
+#!/bin/bash
+# Additional full-size convergence runs on the real TPU, after
+# scripts/tpu_evidence.sh (which covers AC-SA).  Each run is the full
+# reference config; rel-L2 / recovered coefficients land in runs/*.log
+# and are transcribed into CONVERGENCE.md.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+
+echo "=== A. Allen-Cahn baseline (N_f=50k, 10k Adam + 10k L-BFGS) ==="
+timeout 5400 python examples/ac_baseline.py > runs/ac_baseline_full_tpu.log 2>&1
+grep "Error u" runs/ac_baseline_full_tpu.log || tail -3 runs/ac_baseline_full_tpu.log
+
+echo "=== B. Burgers forward (N_f=10k, 10k Adam + 10k L-BFGS) ==="
+timeout 5400 python examples/burgers.py > runs/burgers_full_tpu.log 2>&1
+grep "Error u" runs/burgers_full_tpu.log || tail -3 runs/burgers_full_tpu.log
+
+echo "=== C. Allen-Cahn discovery (512x201 grid, SA, 10k Adam, ckpt+resume) ==="
+timeout 5400 python examples/ac_discovery.py > runs/ac_discovery_full_tpu.log 2>&1
+grep "c1 = " runs/ac_discovery_full_tpu.log || tail -3 runs/ac_discovery_full_tpu.log
+
+echo "=== D. single-chip N_f scaling sweep (50k..500k) ==="
+timeout 3000 python bench.py --scale > BENCH_TPU_scale.json 2> runs/bench_scale_tpu.log
+tail -1 BENCH_TPU_scale.json
+
+echo "ALL EXTRA CONVERGENCE RUNS DONE"

@@ -434,6 +434,7 @@ def scale_payload(out):
     ok = {k: v for k, v in out.items() if "pts_per_sec" in v}
     if not ok:
         return None
+    import jax
     top = max(ok, key=lambda k: int(k))
     note = (" (the size the reference needs multi-GPU for)"
             if int(top) >= 500_000 else "")
@@ -443,6 +444,8 @@ def scale_payload(out):
         "unit": "collocation-pts/sec/chip",
         "vs_baseline": None,
         "mfu": ok[top]["mfu"],
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
         "scale": out,
     }
 
@@ -583,43 +586,55 @@ def worker_main(args):
             "device_kind": r["device_kind"],
             "backend": r["backend"],
         }
+    # every mode records what it actually ran on: jax can fall back to CPU
+    # without erroring, and promotion scripts gate on backend == "tpu"
+    import jax
+    payload.setdefault("backend", jax.default_backend())
+    payload.setdefault("device_kind", jax.devices()[0].device_kind)
     print(json.dumps(payload), flush=True)
 
 
 def run_worker(flags, timeout):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + flags
     log(f"[supervisor] running {' '.join(cmd)} (timeout {timeout}s)")
+    def last_json_line(text):
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "replace")
+        for line in reversed((text or "").strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        return None
+
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout, cwd=REPO)
     except subprocess.TimeoutExpired as e:
         # salvage streamed partial payloads (e.g. --scale prints one line
         # per completed sweep point) before declaring the attempt dead
-        partial = e.stdout or b""
-        if isinstance(partial, bytes):
-            partial = partial.decode("utf-8", "replace")
-        for line in reversed(partial.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    payload = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                payload["partial"] = ("worker timed out after this "
-                                      "measurement; later points lost")
-                return payload, None
+        payload = last_json_line(e.stdout)
+        if payload is not None:
+            payload["partial"] = ("worker timed out after this "
+                                  "measurement; later points lost")
+            return payload, None
         return None, "worker timed out (backend init hang or slow compile)"
     sys.stderr.write(proc.stderr[-4000:] if proc.stderr else "")
     if proc.returncode != 0:
+        # a worker that crashed mid-sweep (OOM/segfault on a later point)
+        # still streamed every completed measurement — salvage like timeout
+        payload = last_json_line(proc.stdout)
+        if payload is not None:
+            payload["partial"] = (f"worker died (rc={proc.returncode}) "
+                                  "after this measurement; later points lost")
+            return payload, None
         tail = (proc.stderr or "").strip().splitlines()[-8:]
         return None, f"worker rc={proc.returncode}: " + " | ".join(tail)
-    for line in reversed((proc.stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                continue
+    payload = last_json_line(proc.stdout)
+    if payload is not None:
+        return payload, None
     return None, "worker produced no JSON line"
 
 
@@ -676,11 +691,13 @@ def main():
         return
     diag.append(err)
 
-    # total failure: still honor the one-JSON-line contract, rc=0
+    # total failure: still honor the one-JSON-line contract, rc=0.  The
+    # backend_note tag lets artifact-promotion scripts refuse to overwrite
+    # a previously captured real measurement with this sentinel.
     print(json.dumps({
         "metric": "AC SA-PINN training throughput (full minimax step)",
         "value": 0, "unit": "collocation-pts/sec/chip",
-        "vs_baseline": None, "diag": diag,
+        "vs_baseline": None, "backend_note": "total-failure", "diag": diag,
     }))
 
 

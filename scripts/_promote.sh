@@ -1,0 +1,29 @@
+# Shared artifact-promotion gate for the TPU evidence scripts (sourced).
+#
+# promote <name> reads runs/<name>.new and moves it to BENCH_TPU_<name>.json
+# ONLY when the last line is a real TPU measurement:
+#   - non-empty file,
+#   - no "backend_note" tag (cpu-fallback / total-failure sentinels),
+#   - records "backend": "tpu" (every bench.py payload carries the backend
+#     it actually ran on; jax can fall back to CPU without erroring).
+# Anything else stays in runs/<name>.new for diagnosis and never clobbers a
+# previously captured artifact.
+
+promote() {
+    local name="$1" new="runs/$1.new"
+    [ -s "$new" ] || { echo "[$name] no output, NOT promoted"; return 1; }
+    if grep -q '"backend_note"' "$new"; then
+        echo "[$name] fallback/failure sentinel kept in $new, NOT promoted"
+        return 1
+    fi
+    if ! grep -q '"backend": "tpu"' "$new"; then
+        echo "[$name] backend is not tpu, kept in $new, NOT promoted"
+        return 1
+    fi
+    if grep -q '"partial"' "$new" && [ -s "BENCH_TPU_$name.json" ]; then
+        echo "[$name] partial sweep kept in $new; complete artifact retained"
+        return 1
+    fi
+    mv "$new" "BENCH_TPU_$name.json"
+    tail -1 "BENCH_TPU_$name.json"
+}

@@ -1,0 +1,69 @@
+"""CPU hedge: reduced Allen-Cahn SA-PINN convergence run.
+
+When the TPU tunnel is down for the whole round, this still demonstrates
+the SA-PINN minimax dynamics converging on Allen-Cahn (SURVEY §7 "hard
+part (b)") at a config one CPU core can finish: N_f=10k, 2-64x3-1,
+10k Adam + 10k L-BFGS, with the non-adaptive control at the same budget.
+The SA-PINN paper's point (arXiv:2009.04544, cited at reference
+models.py:37) is that vanilla PINNs fail on Allen-Cahn (rel-L2 ~0.51)
+while SA weights make it trainable — the reduced pair shows exactly that
+gap.  Full-size TPU numbers land separately via scripts/tpu_evidence.sh.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/cpu_ac_sa_reduced.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples"))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+N_F, NX, NT = 10_000, 512, 201
+WIDTHS = [64, 64, 64]
+ADAM, NEWTON = 10_000, 10_000
+
+
+def run(adaptive: bool):
+    from ac_baseline import build_problem
+
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import CollocationSolverND
+    from tensordiffeq_tpu.exact import allen_cahn_solution
+
+    domain, bcs, f_model = build_problem(N_F, nx=NX, nt=NT)
+    solver = CollocationSolverND(verbose=False)
+    kw = {}
+    if adaptive:
+        rng = np.random.RandomState(0)
+        kw = dict(Adaptive_type=1,
+                  dict_adaptive={"residual": [True], "BCs": [True, False]},
+                  init_weights={"residual": [rng.rand(N_F, 1)],
+                                "BCs": [100.0 * rng.rand(NX, 1), None]})
+    solver.compile([2, *WIDTHS, 1], f_model, domain, bcs, **kw)
+    t0 = time.time()
+    solver.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    x, t, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"adaptive": adaptive, "rel_l2": err, "wall_s": round(wall, 1),
+            "config": f"N_f={N_F}, 2-{'x'.join(map(str, WIDTHS))}-1, "
+                      f"{ADAM} Adam + {NEWTON} L-BFGS"}
+
+
+if __name__ == "__main__":
+    out = []
+    for adaptive in (True, False):
+        r = run(adaptive)
+        out.append(r)
+        print(json.dumps(r), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                           "runs", "cpu_ac_sa_reduced.json"), "w") as fh:
+        json.dump(out, fh, indent=1)

@@ -2,29 +2,35 @@
 # Round-2 TPU evidence queue: run the full measurement suite the moment the
 # TPU tunnel is healthy.  Each step is independent; artifacts land in
 # runs/ and BENCH_TPU_*.json at the repo root.
+#
+# Results are written to runs/<name>.new first and only promoted to the
+# canonical BENCH_TPU_<name>.json when they are real TPU measurements —
+# bench.py falls back to CPU when the tunnel dies mid-suite, and a
+# cpu-fallback line must never clobber a previously captured TPU artifact.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p runs
+. scripts/_promote.sh
 
 echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
 echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
 BENCH_TIMEOUT=5400 timeout 5500 python bench.py --full \
-    > BENCH_TPU_full.json 2> runs/ac_sa_full_tpu.log
-tail -1 BENCH_TPU_full.json
+    > runs/full.new 2> runs/ac_sa_full_tpu.log
+promote full
 
 echo "=== 2. headline throughput (autotune now includes pallas) ==="
-timeout 1800 python bench.py > BENCH_TPU_default.json 2> runs/bench_default_tpu.log
-tail -1 BENCH_TPU_default.json
+timeout 1800 python bench.py > runs/default.new 2> runs/bench_default_tpu.log
+promote default
 
 echo "=== 3. precision axis (incl bf16-taylor) ==="
-timeout 2500 python bench.py --precision > BENCH_TPU_precision.json 2> runs/bench_precision_tpu.log
-tail -1 BENCH_TPU_precision.json
+timeout 2500 python bench.py --precision > runs/precision.new 2> runs/bench_precision_tpu.log
+promote precision
 
 echo "=== 4. engines ==="
-timeout 1800 python bench.py --engines > BENCH_TPU_engines.json 2> runs/bench_engines_tpu.log
-tail -1 BENCH_TPU_engines.json
+timeout 1800 python bench.py --engines > runs/engines.new 2> runs/bench_engines_tpu.log
+promote engines
 
 echo "=== 5. on-hardware kernel parity tests ==="
 timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log

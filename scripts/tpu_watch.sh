@@ -1,0 +1,27 @@
+#!/bin/bash
+# Probe the TPU tunnel; when it answers, run the full evidence queue once.
+# Detached-safe: writes state to runs/tpu_watch.state so a supervisor (or a
+# human) can see where it is.  Probe subprocesses are killed on timeout so a
+# hung dial never wedges the watcher or holds the axon lock.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+STATE=runs/tpu_watch.state
+
+while true; do
+    echo "probing $(date +%H:%M:%S)" > "$STATE"
+    if timeout 120 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu'
+(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()
+print('healthy')
+" 2>/dev/null | grep -q healthy; then
+        echo "healthy $(date +%H:%M:%S) — running evidence suite" > "$STATE"
+        bash scripts/tpu_evidence.sh > runs/tpu_evidence_watch.log 2>&1
+        bash scripts/tpu_convergence_extra.sh > runs/tpu_extra_watch.log 2>&1
+        echo "done $(date +%H:%M:%S)" > "$STATE"
+        exit 0
+    fi
+    echo "unhealthy $(date +%H:%M:%S); retrying in 300s" > "$STATE"
+    sleep 300
+done

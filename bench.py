@@ -594,21 +594,26 @@ def worker_main(args):
     print(json.dumps(payload), flush=True)
 
 
+def last_json_line(text):
+    """Last parseable JSON-object line of a worker's stdout (bytes or str).
+
+    Workers stream one payload line per completed measurement, so this is
+    both the normal result path and the partial-salvage path."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def run_worker(flags, timeout):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + flags
     log(f"[supervisor] running {' '.join(cmd)} (timeout {timeout}s)")
-    def last_json_line(text):
-        if isinstance(text, bytes):
-            text = text.decode("utf-8", "replace")
-        for line in reversed((text or "").strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    return json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-        return None
-
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout, cwd=REPO)

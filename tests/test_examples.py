@@ -41,3 +41,10 @@ def test_checkpoint_transfer_example_runs(tmp_path):
 def test_kdv_example_runs():
     """KdV: third-order derivative path end-to-end (fused engine)."""
     run_example("kdv.py")
+
+
+def test_ac_dist_sa_example_runs():
+    """The scale config's script (reference AC-dist-new.py) on the 8-virtual-
+    device mesh, with SA weights sharded alongside their points and the
+    distributed L-BFGS tail the reference disables."""
+    run_example("ac_dist.py", "--sa")

@@ -570,7 +570,10 @@ class CollocationSolverND:
             batch_sz: Optional[int] = None,
             newton_eager: Optional[bool] = None,
             chunk: int = 100, profile_dir: Optional[str] = None,
-            eval_fn: Optional[Callable] = None, eval_every: int = 0):
+            eval_fn: Optional[Callable] = None, eval_every: int = 0,
+            resample_every: int = 0, resample_pool: int = 4,
+            resample_temp: float = 1.0, resample_uniform: float = 0.1,
+            resample_seed: int = 0):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
         ``fit.py:17-102``).
 
@@ -590,7 +593,16 @@ class CollocationSolverND:
         evaluation hook (e.g. rel-L2 timelines for time-to-accuracy
         benchmarks) firing at chunk boundaries of both phases — training
         state, L-BFGS curvature memory, and compiled runners stay warm, so
-        the measurement is of ONE continuous run."""
+        the measurement is of ONE continuous run.
+
+        ``resample_every`` (beyond-reference; :mod:`..ops.resampling`):
+        every that many Adam epochs, redraw the N_f collocation points by
+        residual-importance sampling from a fresh ``resample_pool``×N_f LHS
+        pool (``p ∝ |f|^resample_temp`` with a ``resample_uniform`` floor).
+        Shapes and sharding are preserved, so the compiled step and Adam
+        moments carry on; the L-BFGS phase refines on the final redraw.
+        Incompatible with per-point residual λ (Adaptive_type=1), whose rows
+        are trained state aligned to their points — the solver raises."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before fit(...)")
         if profile_dir is not None:
@@ -599,7 +611,12 @@ class CollocationSolverND:
                 return self.fit(tf_iter=tf_iter, newton_iter=newton_iter,
                                 batch_sz=batch_sz, newton_eager=newton_eager,
                                 chunk=chunk, eval_fn=eval_fn,
-                                eval_every=eval_every)
+                                eval_every=eval_every,
+                                resample_every=resample_every,
+                                resample_pool=resample_pool,
+                                resample_temp=resample_temp,
+                                resample_uniform=resample_uniform,
+                                resample_seed=resample_seed)
         if self.verbose:
             print_screen(self)
 
@@ -614,6 +631,29 @@ class CollocationSolverND:
         X_f = self.X_f
         lambdas = self.lambdas
 
+        resample_fn = None
+        if resample_every > 0:
+            n_f = int(X_f.shape[0])
+            for lam in lambdas.get("residual", []):
+                if (lam is not None and getattr(lam, "ndim", 0) >= 1
+                        and lam.shape[0] == n_f):
+                    raise ValueError(
+                        "resample_every is incompatible with per-point "
+                        "residual λ (Adaptive_type=1): those weights are "
+                        "trained state row-aligned to their points. Use "
+                        "Adaptive_type 0/2/3, or disable resampling.")
+            from ..ops.resampling import make_residual_resampler
+            base_resampler = make_residual_resampler(
+                self._residual_jit, self.domain.xlimits, n_f,
+                pool_factor=resample_pool, temp=resample_temp,
+                uniform_frac=resample_uniform, seed=resample_seed, like=X_f)
+
+            def resample_fn(params, epoch):
+                X_new = base_resampler(params, epoch)
+                # later phases (L-BFGS) and fit() calls use the final redraw
+                self.X_f = X_new
+                return X_new
+
         result = FitResult()
         result.losses = self.losses
         if tf_iter > 0:
@@ -626,16 +666,25 @@ class CollocationSolverND:
                 # solver-managed state can go stale (e.g. λ rows trimmed by
                 # dist sharding); restart the moments rather than erroring
                 self.opt_state = None
+            ntk_update = None
+            if self._ntk_fn is not None:
+                from ..ops.ntk import residual_subsample
+
+                def ntk_update(p):
+                    # live X_f: the NTK balance follows adaptive resampling
+                    # (and any dist trimming) instead of the compile-time set
+                    return self._ntk_fn(p, residual_subsample(self.X_f))
             trainables, self.opt_state, result = fit_adam(
                 self.loss_fn, self.params, lambdas, X_f,
                 tf_iter=tf_iter, batch_sz=batch_sz, lr=self.lr,
                 lr_weights=self.lr_weights, chunk=chunk,
                 verbose=self.verbose, result=result,
                 opt_state=self.opt_state, freeze_lambdas=freeze,
-                lambda_update_fn=self._ntk_fn, mesh=mesh,
+                lambda_update_fn=ntk_update, mesh=mesh,
                 callback=(None if eval_fn is None else
                           (lambda e, p: eval_fn("adam", e, p))),
-                callback_every=eval_every)
+                callback_every=eval_every,
+                resample_fn=resample_fn, resample_every=resample_every)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
             self.best_model["adam"] = result.best_params["adam"]
@@ -645,7 +694,7 @@ class CollocationSolverND:
         if newton_iter > 0:
             from ..training.lbfgs import fit_lbfgs
             params, best_params, best_loss, best_iter, lbfgs_losses = fit_lbfgs(
-                self.loss_fn_refine, self.params, self.lambdas, X_f,
+                self.loss_fn_refine, self.params, self.lambdas, self.X_f,
                 maxiter=newton_iter, verbose=self.verbose,
                 eager=bool(newton_eager),
                 callback=(None if eval_fn is None else

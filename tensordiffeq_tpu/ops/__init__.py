@@ -4,3 +4,5 @@ from .derivatives import (UFn, d, grad, laplacian, make_ufn,  # noqa: F401
                           set_default_grad_mode, vmap_residual)
 from .losses import MSE, default_g, g_MSE, relative_l2  # noqa: F401
 from .meshes import flatten_and_stack, grid_points, multimesh  # noqa: F401
+from .resampling import (importance_select,  # noqa: F401
+                         make_residual_resampler, residual_scores)

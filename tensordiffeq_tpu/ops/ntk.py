@@ -38,6 +38,15 @@ def _subsample(arr: jnp.ndarray, n: Optional[int]) -> jnp.ndarray:
     return arr[idx]
 
 
+def residual_subsample(X_f, max_points: int = 256) -> jnp.ndarray:
+    """The residual-term evaluation points for the NTK traces: the same
+    deterministic stride subsample ``build_error_fns`` takes at build time,
+    computable from the *current* collocation set — so callers whose ``X_f``
+    changes during training (adaptive resampling, dist trimming) can keep the
+    traces aligned with the points actually being trained."""
+    return _subsample(jnp.asarray(X_f, jnp.float32), max_points)
+
+
 def build_error_fns(apply_fn: Callable, varnames: Sequence[str], n_out: int,
                     f_model: Callable, bcs: Sequence[BC], X_f: jnp.ndarray,
                     n_residuals: int, max_points: int = 256,
@@ -100,13 +109,18 @@ def build_error_fns(apply_fn: Callable, varnames: Sequence[str], n_out: int,
 
             bc_fns.append(e_value)
 
-    X_sub = _subsample(jnp.asarray(X_f, jnp.float32), max_points)
+    X_sub0 = residual_subsample(X_f, max_points)
 
-    def res_all_fn(params):
+    def res_all_fn(params, X_sub=None):
         """All residual components stacked as ``[n_residuals, m]`` — one
-        forward + one Jacobian pass covers every equation of a system."""
+        forward + one Jacobian pass covers every equation of a system.
+
+        ``X_sub`` overrides the build-time subsample (pass
+        :func:`residual_subsample` of the live collocation set when it can
+        change during training)."""
+        pts = X_sub0 if X_sub is None else X_sub
         u = make_ufn(apply_fn, params, varnames, n_out)
-        out = vmap_residual(f_model, u, ndim)(X_sub)
+        out = vmap_residual(f_model, u, ndim)(pts)
         out = out if isinstance(out, tuple) else (out,)
         assert len(out) == n_residuals, (len(out), n_residuals)
         return jnp.stack([o.ravel() for o in out])
@@ -132,17 +146,21 @@ def trace_K(e_fn: Callable, params) -> jnp.ndarray:
 def make_ntk_weight_fn(bc_fns, res_all_fn, n_residuals: int, data_fn=None,
                        eps: float = 1e-12) -> Callable:
     """Build the jitted weight-update function
-    ``ntk_weights(params) -> {"BCs": [...], "residual": [...][, "data": [...]]}``
+    ``ntk_weights(params[, X_sub]) -> {"BCs": [...], "residual": [...][, "data": [...]]}``
     with each weight a 0-d scalar array λ_i = Σ tr K / tr K_i, matching the
     lambdas pytree the solver trains (the optional ``"data"`` entry weights
-    the assimilation term)."""
+    the assimilation term).  ``X_sub`` re-points the residual traces at the
+    current collocation subsample (see :func:`residual_subsample`) so the
+    balance follows adaptive resampling."""
 
     @jax.jit
-    def ntk_weights(params):
+    def ntk_weights(params, X_sub=None):
         bc_traces = [trace_K(f, params) for f in bc_fns]
         # one Jacobian of the stacked [n_res, m] residual matrix; per-row
         # Frobenius norms give every equation's trace in a single pass
-        J = jax.jacrev(res_all_fn)(params)
+        res_fn = (res_all_fn if X_sub is None
+                  else (lambda p: res_all_fn(p, X_sub)))
+        J = jax.jacrev(res_fn)(params)
         res_traces_vec = sum(
             jnp.sum(jnp.square(leaf), axis=tuple(range(1, leaf.ndim)))
             for leaf in jax.tree_util.tree_leaves(J))

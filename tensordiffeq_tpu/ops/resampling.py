@@ -1,0 +1,106 @@
+"""Residual-based adaptive collocation resampling (beyond-reference).
+
+The reference trains on one fixed Latin-Hypercube draw for the whole run
+(``domains.py:12-20``); every retrieved adaptive-collocation result
+(PACMANN, arXiv:2411.19632; importance sampling for PINNs, arXiv:2104.12325)
+says the same budget converges faster when points concentrate where the PDE
+residual is large.  This module adds that as a *redraw*, not a point-mover:
+
+* every ``resample_every`` epochs (at a chunk boundary of the jitted Adam
+  scan), draw a fresh LHS **pool** of ``pool_factor x N_f`` candidates,
+* score the pool with the solver's compiled residual (one jitted forward,
+  data-parallel under ``dist=True``),
+* keep ``N_f`` points by importance sampling ``p ∝ |f|^temp`` mixed with a
+  ``uniform_frac`` floor (coverage never collapses onto one feature),
+  drawn without replacement via the Gumbel top-k trick (O(pool), no
+  sequential host loop).
+
+TPU-shaped by construction: ``N_f`` is constant, so the training step's
+compiled program, optimizer state, and (under ``dist``) the ``"data"``
+sharding layout are all reused — the host only swaps the buffer contents
+between device chunks.  Incompatible with *per-point* residual λ
+(Adaptive_type=1): those weights are row-aligned with their points and have
+trained ascent state; the solver raises rather than silently re-seeding
+them (scalar/outside-sum and NTK weighting compose fine).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import LatinHypercubeSample
+
+
+def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
+                      uniform_frac: float = 0.1,
+                      rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Indices of ``n_keep`` rows drawn without replacement with probability
+    ``∝ (1-u)·|s|^temp/Σ + u/N`` — Gumbel top-k, vectorized.
+
+    ``uniform_frac=1`` degenerates to a uniform redraw; ``temp`` sharpens
+    (>1) or flattens (<1) the residual concentration."""
+    rng = rng or np.random.default_rng(0)
+    s = np.abs(np.asarray(scores, np.float64)).ravel()
+    if n_keep >= s.size:
+        return np.arange(s.size)
+    p = s ** temp
+    tot = p.sum()
+    if not np.isfinite(tot) or tot <= 0.0:
+        p = np.full(s.size, 1.0 / s.size)
+    else:
+        p = (1.0 - uniform_frac) * p / tot + uniform_frac / s.size
+    gumbel = rng.gumbel(size=s.size)
+    keys = np.log(p) + gumbel
+    return np.argpartition(-keys, n_keep)[:n_keep]
+
+
+def residual_scores(residual_fn: Callable, params, X) -> np.ndarray:
+    """``[N]`` importance scores: |residual| summed over outputs/equations."""
+    f = residual_fn(params, X)
+    parts = f if isinstance(f, tuple) else (f,)
+    s = None
+    for part in parts:
+        a = np.abs(np.asarray(part, np.float64))
+        a = a.reshape(a.shape[0], -1).sum(axis=1)
+        s = a if s is None else s + a
+    return s
+
+
+def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
+                            n_f: int, *, pool_factor: int = 4,
+                            temp: float = 1.0, uniform_frac: float = 0.1,
+                            seed: int = 0,
+                            like=None) -> Callable:
+    """Build ``resample(params, epoch) -> X_new`` for the fit loop.
+
+    ``like``: an existing (possibly sharded) collocation array — the fresh
+    pool and the selected points are placed with its sharding so the redraw
+    is transparent to a ``dist=True`` compiled step.  Each call uses a
+    different pool seed (``seed + epoch``) so successive redraws explore."""
+    placement = getattr(like, "sharding", None)
+    n_pool = max(int(pool_factor) * n_f, n_f)
+    if placement is not None and getattr(placement, "mesh", None) is not None:
+        n_dev = int(np.prod(placement.mesh.devices.shape))
+        n_pool -= n_pool % n_dev  # pool shards evenly, scoring rides the mesh
+
+    def resample(params, epoch: int) -> jnp.ndarray:
+        pool = LatinHypercubeSample(n_pool, xlimits, criterion="c",
+                                    seed=seed + int(epoch))
+        pool_j = jnp.asarray(pool, jnp.float32)
+        if placement is not None:
+            pool_j = jax.device_put(pool_j, placement)
+        scores = residual_scores(residual_fn, params, pool_j)
+        rng = np.random.default_rng(seed + int(epoch))
+        idx = importance_select(scores, n_f, temp=temp,
+                                uniform_frac=uniform_frac, rng=rng)
+        X_new = jnp.asarray(pool[np.sort(idx)], jnp.float32)
+        if placement is not None:
+            X_new = jax.device_put(X_new, placement)
+        return X_new
+
+    return resample

@@ -232,6 +232,8 @@ def fit_adam(loss_fn: Callable,
              mesh=None,
              callback: Optional[Callable] = None,
              callback_every: int = 0,
+             resample_fn: Optional[Callable] = None,
+             resample_every: int = 0,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -245,7 +247,13 @@ def fit_adam(loss_fn: Callable,
     ``callback(epoch, params)`` fires at chunk boundaries whenever the epoch
     count crosses a multiple of ``callback_every`` — periodic evaluation
     (e.g. rel-L2 timelines) WITHOUT splitting training into separate fit
-    calls, so the jitted runner and optimizer state stay warm."""
+    calls, so the jitted runner and optimizer state stay warm.
+
+    ``resample_fn(params, epoch) -> X_new`` + ``resample_every``: adaptive
+    collocation redraw (:mod:`..ops.resampling`) at the same chunk-boundary
+    cadence.  ``X_new`` must keep the original shape/sharding, so the
+    compiled runner and optimizer state carry straight on — only the batch
+    buffers are rebuilt."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
@@ -288,7 +296,25 @@ def fit_adam(loss_fn: Callable,
         prev_epochs = steps_done // n_batches
         steps_done += n
         cur_epochs = steps_done // n_batches
+        if (resample_fn is not None and resample_every > 0
+                and steps_done < total_steps
+                and prev_epochs // resample_every != cur_epochs // resample_every):
+            X_new = resample_fn(trainables["params"], cur_epochs)
+            if X_new.shape != X_f.shape:
+                raise ValueError(
+                    f"resample_fn changed the collocation shape "
+                    f"{X_f.shape} -> {X_new.shape}; the redraw must keep "
+                    "N_f so the compiled step is reused")
+            X_f = X_new
+            X_batched, idx_batched, _ = make_batches(
+                X_f, batch_sz, mesh=mesh, verbose=False)
+            # losses before/after a redraw are measured on different point
+            # sets (importance sampling deliberately picks harder points) —
+            # reset the threshold so best-model tracking keeps competing on
+            # the new set instead of freezing at a pre-redraw snapshot
+            best = (best[0], jnp.asarray(jnp.inf), best[2])
         if lambda_update_fn is not None and steps_done < total_steps:
+            # after any redraw, so NTK balances the points actually trained
             trainables["lambdas"] = lambda_update_fn(trainables["params"])
         if (callback is not None and callback_every > 0
                 and prev_epochs // callback_every != cur_epochs // callback_every):

@@ -1,0 +1,134 @@
+"""Adaptive collocation resampling (ops/resampling.py, beyond-reference).
+
+Covers the selection math, the end-to-end fit hook (shape/sharding
+preservation, compiled-step reuse), the per-point-λ guard, and the dist
+path on the 8-virtual-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, dirichletBC, grad
+from tensordiffeq_tpu.ops.resampling import (importance_select,
+                                             make_residual_resampler,
+                                             residual_scores)
+
+
+def test_importance_select_concentrates_and_covers():
+    rng = np.random.default_rng(0)
+    scores = np.ones(10_000)
+    scores[:1_000] = 50.0  # hot region: 10% of pool, ~98% of mass
+    idx = importance_select(scores, 2_000, temp=1.0, uniform_frac=0.1, rng=rng)
+    assert idx.shape == (2_000,)
+    assert len(np.unique(idx)) == 2_000  # without replacement
+    hot = (idx < 1_000).mean()
+    assert hot > 0.4  # concentrates far beyond the 10% base rate
+    assert hot < 1.0  # uniform floor keeps cold-region coverage
+    # degenerate scores fall back to uniform instead of dying
+    idx = importance_select(np.zeros(100), 10, rng=rng)
+    assert len(np.unique(idx)) == 10
+    # keep-everything is the identity
+    assert importance_select(np.ones(5), 5).tolist() == [0, 1, 2, 3, 4]
+
+
+def test_residual_scores_sums_outputs_and_tuples():
+    def res_single(params, X):
+        return X[:, :1] * 2.0
+
+    def res_tuple(params, X):
+        return (X[:, :1], jnp.stack([X[:, 1], X[:, 1]], axis=1))
+
+    X = jnp.asarray(np.array([[1.0, -3.0], [2.0, 0.5]]), jnp.float32)
+    assert np.allclose(residual_scores(res_single, None, X), [2.0, 4.0])
+    assert np.allclose(residual_scores(res_tuple, None, X), [7.0, 3.0])
+
+
+def _burgers_solver(n_f=600, dist=False, adaptive=None):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 64)
+    domain.add("t", [0.0, 1.0], 16)
+    domain.generate_collocation_points(n_f, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                - (0.01 / np.pi) * grad(u_x, "x")(x, t))
+
+    kw = dict(adaptive or {})
+    solver = CollocationSolverND(verbose=False)
+    solver.compile([2, 16, 16, 1], f_model, domain, bcs, dist=dist, **kw)
+    return solver
+
+
+def test_resampler_targets_high_residual_regions():
+    solver = _burgers_solver()
+    resample = make_residual_resampler(
+        solver._residual_jit, solver.domain.xlimits, 400,
+        pool_factor=4, uniform_frac=0.0, seed=1)
+    X_new = resample(solver.params, epoch=0)
+    assert X_new.shape == (400, 2)
+    # points stay inside the domain box
+    assert float(X_new[:, 0].min()) >= -1.0 and float(X_new[:, 0].max()) <= 1.0
+    assert float(X_new[:, 1].min()) >= 0.0 and float(X_new[:, 1].max()) <= 1.0
+    # mean |f| over the selected points beats a uniform draw's mean |f|
+    uniform = tdq.utils.LatinHypercubeSample(400, solver.domain.xlimits,
+                                             seed=7)
+    s_sel = residual_scores(solver._residual_jit, solver.params, X_new).mean()
+    s_uni = residual_scores(solver._residual_jit, solver.params,
+                            jnp.asarray(uniform, jnp.float32)).mean()
+    assert s_sel > s_uni
+
+
+def test_fit_with_resampling_trains_and_swaps_points():
+    solver = _burgers_solver()
+    X0 = np.asarray(solver.X_f).copy()
+    solver.fit(tf_iter=60, newton_iter=0, chunk=10, resample_every=20,
+               resample_seed=3)
+    assert len(solver.losses) == 60
+    assert solver.losses[-1]["Total Loss"] < solver.losses[0]["Total Loss"]
+    X1 = np.asarray(solver.X_f)
+    assert X1.shape == X0.shape
+    assert not np.allclose(X0, X1)  # the redraw really replaced the set
+    # L-BFGS continues on the resampled set without error
+    solver.fit(tf_iter=0, newton_iter=10)
+
+
+def test_resampling_rejects_per_point_lambdas():
+    n_f = 600
+    rng = np.random.RandomState(0)
+    solver = _burgers_solver(
+        n_f=n_f,
+        adaptive=dict(Adaptive_type=1,
+                      dict_adaptive={"residual": [True],
+                                     "BCs": [False, False, False]},
+                      init_weights={"residual": [rng.rand(n_f, 1)],
+                                    "BCs": [None, None, None]}))
+    with pytest.raises(ValueError, match="per-point"):
+        solver.fit(tf_iter=10, resample_every=5)
+
+
+def test_resampling_composes_with_ntk():
+    """Adaptive_type=3 + resample_every: the NTK balance is recomputed from
+    the LIVE collocation set (residual_subsample threads self.X_f), not the
+    compile-time one."""
+    solver = _burgers_solver(adaptive=dict(Adaptive_type=3))
+    X0 = np.asarray(solver.X_f).copy()
+    solver.fit(tf_iter=30, newton_iter=0, chunk=10, resample_every=10)
+    assert not np.allclose(X0, np.asarray(solver.X_f))
+    lam = [float(v) for v in solver.lambdas["BCs"]] + \
+          [float(v) for v in solver.lambdas["residual"]]
+    assert all(np.isfinite(v) and v > 0 for v in lam)
+    assert solver.losses[-1]["Total Loss"] < solver.losses[0]["Total Loss"]
+
+
+def test_resampling_dist_preserves_sharding(eight_devices):
+    solver = _burgers_solver(n_f=640, dist=True)
+    solver.fit(tf_iter=20, newton_iter=0, chunk=5, resample_every=10)
+    assert "data" in str(getattr(solver.X_f.sharding, "spec", ""))
+    assert solver.losses[-1]["Total Loss"] < solver.losses[0]["Total Loss"]

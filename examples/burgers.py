@@ -3,6 +3,10 @@
 u_t + u u_x = (0.01/pi) u_xx on x in [-1,1], t in [0,1];
 u(x,0) = -sin(pi x), u(+-1,t) = 0.  N_f=10k, 2-20x8-1 tanh MLP,
 10k Adam + 10k L-BFGS; validates rel-L2 against the Cole-Hopf solution.
+
+``--resample N`` turns on residual-importance collocation resampling
+(beyond-reference, ops/resampling.py): redraw the N_f points every N Adam
+epochs toward where |f| is large — the shock line here.
 """
 
 import numpy as np
@@ -16,7 +20,9 @@ from tensordiffeq_tpu.exact import burgers_solution
 
 
 def main():
-    args = example_args("Burgers shock forward PINN")
+    args = example_args("Burgers shock forward PINN",
+                        resample=(0, "redraw collocation points every N "
+                                     "Adam epochs (0 = reference fixed set)"))
 
     domain = DomainND(["x", "t"], time_var="t")
     domain.add("x", [-1.0, 1.0], 256)
@@ -36,7 +42,8 @@ def main():
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
     solver.fit(tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100))
+               newton_iter=scaled(args, 10_000, 100),
+               resample_every=args.resample)
 
     x, t, usol = burgers_solution()
     Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)

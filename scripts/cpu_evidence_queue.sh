@@ -1,0 +1,24 @@
+#!/bin/bash
+# CPU-side evidence queue (runs while/if the TPU tunnel is down): waits for
+# any earlier CPU job to finish, then full-size steady-state convergence
+# runs — these configs fit a single CPU core (BASELINE.md scale).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+while pgrep -f "cpu_ac_sa_reduced.py|resample_ablation.py" > /dev/null; do
+    sleep 120
+done
+
+echo "=== Poisson steady-state (full: N_f=100 grid, 4000 Adam) ==="
+timeout 3600 nice -n 10 python examples/steady_state_poisson.py \
+    > runs/poisson_full_cpu.log 2>&1
+grep -a "Error u" runs/poisson_full_cpu.log || tail -2 runs/poisson_full_cpu.log
+
+echo "=== Helmholtz steady-state (full: N_f=10k, 10k Adam + 10k L-BFGS) ==="
+timeout 7200 nice -n 10 python examples/steady_state_helmholtz.py \
+    > runs/helmholtz_full_cpu.log 2>&1
+grep -a "Error u" runs/helmholtz_full_cpu.log || tail -2 runs/helmholtz_full_cpu.log
+
+echo "CPU EVIDENCE QUEUE DONE"

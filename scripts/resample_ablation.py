@@ -1,0 +1,91 @@
+"""Ablation: residual-importance resampling vs the reference's fixed draw.
+
+Trains the same Burgers problem twice at the same budget — one fixed LHS
+collocation set (the reference's only mode, ``domains.py:12-20``) and one
+with ``resample_every`` redraws — and reports rel-L2 vs the Cole-Hopf
+solution for each.  Writes runs/resample_ablation.json.
+
+Usage:
+  python scripts/resample_ablation.py              # TPU if reachable
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/resample_ablation.py
+  ... --quick       tiny budget smoke run
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
+                              grad)
+from tensordiffeq_tpu.exact import burgers_solution
+
+
+def build(n_f, seed=0):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(n_f, seed=seed)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                - (0.01 / np.pi) * grad(u_x, "x")(x, t))
+
+    return domain, bcs, f_model
+
+
+def run(n_f, widths, adam, newton, resample_every):
+    domain, bcs, f_model = build(n_f)
+    solver = CollocationSolverND(verbose=False)
+    solver.compile([2, *widths, 1], f_model, domain, bcs)
+    t0 = time.time()
+    solver.fit(tf_iter=adam, newton_iter=newton,
+               resample_every=resample_every)
+    wall = time.time() - t0
+    x, t, usol = burgers_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"resample_every": resample_every, "rel_l2": err,
+            "wall_s": round(wall, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    if args.quick:
+        n_f, widths, adam, newton, every = 1_000, [20, 20], 400, 200, 100
+    else:
+        n_f, widths, adam, newton, every = 5_000, [20] * 4, 3_000, 2_000, 500
+
+    import jax
+    out = {"backend": jax.default_backend(),
+           "config": f"Burgers N_f={n_f}, 2-{'x'.join(map(str, widths))}-1, "
+                     f"{adam} Adam + {newton} L-BFGS",
+           "runs": []}
+    for mode in (0, every):
+        r = run(n_f, widths, adam, newton, mode)
+        out["runs"].append(r)
+        print(json.dumps(r), flush=True)
+    fixed = out["runs"][0]["rel_l2"]
+    ada = out["runs"][1]["rel_l2"]
+    out["improvement"] = round(fixed / ada, 2) if ada > 0 else None
+    print(json.dumps({"improvement_vs_fixed": out["improvement"]}))
+    with open(os.path.join(ROOT, "runs", "resample_ablation.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()

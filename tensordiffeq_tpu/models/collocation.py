@@ -647,9 +647,13 @@ class CollocationSolverND:
                 self._residual_jit, self.domain.xlimits, n_f,
                 pool_factor=resample_pool, temp=resample_temp,
                 uniform_frac=resample_uniform, seed=resample_seed, like=X_f)
+            # fit_adam restarts epoch numbering at 0 each call; offset by the
+            # epochs already trained so a warm-restarted fit() explores new
+            # pools instead of replaying the previous run's draws
+            epoch_offset = len(self.losses)
 
             def resample_fn(params, epoch):
-                X_new = base_resampler(params, epoch)
+                X_new = base_resampler(params, epoch + epoch_offset)
                 # later phases (L-BFGS) and fit() calls use the final redraw
                 self.X_f = X_new
                 return X_new
@@ -666,13 +670,17 @@ class CollocationSolverND:
                 # solver-managed state can go stale (e.g. λ rows trimmed by
                 # dist sharding); restart the moments rather than erroring
                 self.opt_state = None
-            ntk_update = None
-            if self._ntk_fn is not None:
+            ntk_update = self._ntk_fn
+            if self._ntk_fn is not None and resample_fn is not None:
+                # only when resampling: thread the LIVE collocation subsample
+                # into the residual traces so the balance follows each
+                # redraw.  The plain path keeps the compile-time points baked
+                # inside jit — an eager gather here would break multi-host
+                # dist meshes (X_f spans non-addressable devices), and
+                # resampling itself is gated to single-host.
                 from ..ops.ntk import residual_subsample
 
                 def ntk_update(p):
-                    # live X_f: the NTK balance follows adaptive resampling
-                    # (and any dist trimming) instead of the compile-time set
                     return self._ntk_fn(p, residual_subsample(self.X_f))
             trainables, self.opt_state, result = fit_adam(
                 self.loss_fn, self.params, lambdas, X_f,

@@ -9,7 +9,8 @@ residual is large.  This module adds that as a *redraw*, not a point-mover:
 * every ``resample_every`` epochs (at a chunk boundary of the jitted Adam
   scan), draw a fresh LHS **pool** of ``pool_factor x N_f`` candidates,
 * score the pool with the solver's compiled residual (one jitted forward,
-  data-parallel under ``dist=True``),
+  data-parallel across a single host's mesh under ``dist=True``; scoring
+  gathers |f| to the host, so a multi-*host* mesh raises up front),
 * keep ``N_f`` points by importance sampling ``p ∝ |f|^temp`` mixed with a
   ``uniform_frac`` floor (coverage never collapses onto one feature),
   drawn without replacement via the Gumbel top-k trick (O(pool), no
@@ -88,14 +89,23 @@ def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
         n_dev = int(np.prod(placement.mesh.devices.shape))
         n_pool -= n_pool % n_dev  # pool shards evenly, scoring rides the mesh
 
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "adaptive resampling on a multi-host mesh is not supported yet: "
+            "pool scoring gathers |f| to the host, which cannot fetch a "
+            "cross-host array")
+
     def resample(params, epoch: int) -> jnp.ndarray:
+        # two decorrelated streams per redraw (pool LHS vs selection noise),
+        # both keyed on (seed, epoch) so distinct epochs explore new pools
+        pool_ss, sel_ss = np.random.SeedSequence([seed, int(epoch)]).spawn(2)
         pool = LatinHypercubeSample(n_pool, xlimits, criterion="c",
-                                    seed=seed + int(epoch))
+                                    seed=int(pool_ss.generate_state(1)[0]))
         pool_j = jnp.asarray(pool, jnp.float32)
         if placement is not None:
             pool_j = jax.device_put(pool_j, placement)
         scores = residual_scores(residual_fn, params, pool_j)
-        rng = np.random.default_rng(seed + int(epoch))
+        rng = np.random.default_rng(sel_ss)
         idx = importance_select(scores, n_f, temp=temp,
                                 uniform_frac=uniform_frac, rng=rng)
         X_new = jnp.asarray(pool[np.sort(idx)], jnp.float32)

@@ -49,4 +49,22 @@ if healthy; then
     promote scale
 else echo "SKIP: tunnel unhealthy"; fi
 
+echo "=== E. KdV soliton (N_f=20k, third-order fused engine, 10k+10k) ==="
+if healthy; then
+    timeout 5400 python examples/kdv.py > runs/kdv_full_tpu.log 2>&1
+    grep -a "Error u" runs/kdv_full_tpu.log || tail -3 runs/kdv_full_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
+echo "=== F. 2D Burgers (N_f=20k 3-D domain, 1k+1k) ==="
+if healthy; then
+    timeout 3600 python examples/burgers2d.py > runs/burgers2d_full_tpu.log 2>&1
+    grep -a "Error u" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
+echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="
+if healthy; then
+    timeout 2400 python scripts/resample_ablation.py > runs/resample_ablation_tpu.log 2>&1
+    tail -2 runs/resample_ablation_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
 echo "ALL EXTRA CONVERGENCE RUNS DONE"

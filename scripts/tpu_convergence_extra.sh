@@ -4,7 +4,8 @@
 # reference config; rel-L2 / recovered coefficients land in runs/*.log
 # and are transcribed into CONVERGENCE.md.
 #
-# A health probe gates every step: if the tunnel died mid-suite the
+# Steps are idempotent (skipped once their success marker exists) and each
+# is gated on a fresh tunnel-health probe: if the tunnel died mid-suite the
 # examples would pin CPU (examples/_common.py::resolve_backend) and churn
 # for hours at full size — skip instead, a later watcher pass retries.
 set -u
@@ -22,26 +23,34 @@ assert jax.devices()[0].platform != 'cpu'
 " 2>/dev/null
 }
 
+done_marker() {  # done_marker <file> <pattern>
+    [ -s "$1" ] && grep -aq "$2" "$1"
+}
+
 echo "=== A. Allen-Cahn baseline (N_f=50k, 10k Adam + 10k L-BFGS) ==="
-if healthy; then
+if done_marker runs/ac_baseline_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
     timeout 5400 python examples/ac_baseline.py > runs/ac_baseline_full_tpu.log 2>&1
-    grep "Error u" runs/ac_baseline_full_tpu.log || tail -3 runs/ac_baseline_full_tpu.log
+    grep -a "Error u" runs/ac_baseline_full_tpu.log || tail -3 runs/ac_baseline_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== B. Burgers forward (N_f=10k, 10k Adam + 10k L-BFGS) ==="
-if healthy; then
+if done_marker runs/burgers_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
     timeout 5400 python examples/burgers.py > runs/burgers_full_tpu.log 2>&1
-    grep "Error u" runs/burgers_full_tpu.log || tail -3 runs/burgers_full_tpu.log
+    grep -a "Error u" runs/burgers_full_tpu.log || tail -3 runs/burgers_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== C. Allen-Cahn discovery (512x201 grid, SA, 10k Adam, ckpt+resume) ==="
-if healthy; then
+if done_marker runs/ac_discovery_full_tpu.log "c1 = "; then echo "done already"
+elif healthy; then
     timeout 5400 python examples/ac_discovery.py > runs/ac_discovery_full_tpu.log 2>&1
-    grep "c1 = " runs/ac_discovery_full_tpu.log || tail -3 runs/ac_discovery_full_tpu.log
+    grep -a "c1 = " runs/ac_discovery_full_tpu.log || tail -3 runs/ac_discovery_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== D. single-chip N_f scaling sweep (50k..500k) ==="
-if healthy; then
+if [ -s BENCH_TPU_scale.json ]; then echo "done already"
+elif healthy; then
     # internal budget 1500s/attempt: TPU attempt + CPU fallback both fit
     # inside the outer guard with headroom for compiles
     BENCH_TIMEOUT=1500 timeout 4800 python bench.py --scale \
@@ -50,19 +59,22 @@ if healthy; then
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== E. KdV soliton (N_f=20k, third-order fused engine, 10k+10k) ==="
-if healthy; then
+if done_marker runs/kdv_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
     timeout 5400 python examples/kdv.py > runs/kdv_full_tpu.log 2>&1
     grep -a "Error u" runs/kdv_full_tpu.log || tail -3 runs/kdv_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== F. 2D Burgers (N_f=20k 3-D domain, 1k+1k) ==="
-if healthy; then
+if done_marker runs/burgers2d_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
     timeout 3600 python examples/burgers2d.py > runs/burgers2d_full_tpu.log 2>&1
     grep -a "Error u" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="
-if healthy; then
+if done_marker runs/resample_ablation_tpu.log "improvement"; then echo "done already"
+elif healthy; then
     timeout 2400 python scripts/resample_ablation.py > runs/resample_ablation_tpu.log 2>&1
     tail -2 runs/resample_ablation_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi

@@ -1,12 +1,13 @@
 #!/bin/bash
 # Round-2 TPU evidence queue: run the full measurement suite the moment the
-# TPU tunnel is healthy.  Each step is independent; artifacts land in
-# runs/ and BENCH_TPU_*.json at the repo root.
+# TPU tunnel is healthy.  Each step is independent AND idempotent — a step
+# whose canonical artifact already exists is skipped, so the watcher can
+# re-pass after a mid-suite tunnel death and only fill the gaps.
 #
 # Results are written to runs/<name>.new first and only promoted to the
-# canonical BENCH_TPU_<name>.json when they are real TPU measurements —
-# bench.py falls back to CPU when the tunnel dies mid-suite, and a
-# cpu-fallback line must never clobber a previously captured TPU artifact.
+# canonical BENCH_TPU_<name>.json when they are real TPU measurements
+# (scripts/_promote.sh): bench.py falls back to CPU when the tunnel dies
+# mid-suite, and a cpu-fallback line must never clobber a TPU artifact.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p runs
@@ -16,23 +17,34 @@ echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
 echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
-BENCH_TIMEOUT=5400 timeout 5500 python bench.py --full \
-    > runs/full.new 2> runs/ac_sa_full_tpu.log
-promote full
+if [ -s BENCH_TPU_full.json ]; then echo "already captured"; else
+    BENCH_TIMEOUT=5400 timeout 5500 python bench.py --full \
+        > runs/full.new 2> runs/ac_sa_full_tpu.log
+    promote full
+fi
 
 echo "=== 2. headline throughput (autotune now includes pallas) ==="
+# always re-run: the tracked artifact predates the pallas autotune fix, and
+# promote() only replaces it with a real TPU measurement
 timeout 1800 python bench.py > runs/default.new 2> runs/bench_default_tpu.log
 promote default
 
 echo "=== 3. precision axis (incl bf16-taylor) ==="
-timeout 2500 python bench.py --precision > runs/precision.new 2> runs/bench_precision_tpu.log
-promote precision
+if [ -s BENCH_TPU_precision.json ]; then echo "already captured"; else
+    timeout 2500 python bench.py --precision > runs/precision.new 2> runs/bench_precision_tpu.log
+    promote precision
+fi
 
 echo "=== 4. engines ==="
+# always re-run (old artifact lacks the backend field); promote-gated
 timeout 1800 python bench.py --engines > runs/engines.new 2> runs/bench_engines_tpu.log
 promote engines
 
 echo "=== 5. on-hardware kernel parity tests ==="
-timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log
+if [ -s runs/hwtests_tpu.log ] && grep -q "passed" runs/hwtests_tpu.log; then
+    echo "already captured"
+else
+    timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log
+fi
 
 echo "ALL TPU EVIDENCE CAPTURED"

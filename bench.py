@@ -353,6 +353,14 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
         # itself stays f32) — the MXU-native path for the PINN hot loop
         "bf16-taylor": {"fused": True, "fused_dtype": "bfloat16"},
     }
+    from tensordiffeq_tpu.ops import pallas_taylor
+    if pallas_taylor.available():
+        # the VMEM-resident kernel with bf16 matmul operands — candidate
+        # fastest config on real TPU (pallas won the f32 engine race)
+        configs["bf16-pallas"] = {"fused": "pallas",
+                                  "fused_dtype": "bfloat16"}
+    else:
+        log("[precision] bf16-pallas excluded (no real TPU backend)")
     # single-device solvers (no dist=True): per-chip == measured
     n_chips = 1
     out = {}

@@ -59,11 +59,14 @@ def run(adaptive: bool):
 
 
 if __name__ == "__main__":
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "runs", "cpu_ac_sa_reduced.json")
     out = []
     for adaptive in (True, False):
         r = run(adaptive)
         out.append(r)
         print(json.dumps(r), flush=True)
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                           "runs", "cpu_ac_sa_reduced.json"), "w") as fh:
-        json.dump(out, fh, indent=1)
+        # dump after EVERY variant: a killed control run must not lose the
+        # already-finished adaptive result
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)

@@ -25,12 +25,18 @@ def soliton(x, t):
 
 
 def main():
-    args = example_args("KdV single-soliton forward PINN (3rd-order fused)")
+    args = example_args("KdV single-soliton forward PINN (3rd-order fused)",
+                        nf=(0, "override N_f (0 = config default)"),
+                        adam=(0, "override Adam iters (0 = config default)"),
+                        newton=(0, "override L-BFGS iters (0 = config "
+                                   "default; Adam-only runs aren't "
+                                   "expressible here)"))
 
     domain = DomainND(["x", "t"], time_var="t")
     domain.add("x", [-10.0, 10.0], 256)
     domain.add("t", [0.0, 1.0], 100)
-    domain.generate_collocation_points(scaled(args, 20_000, 1_500), seed=0)
+    domain.generate_collocation_points(
+        args.nf or scaled(args, 20_000, 1_500), seed=0)
 
     bcs = [IC(domain, [lambda x: soliton(x, 0.0)], var=[["x"]]),
            FunctionDirichletBC(domain, [lambda t: soliton(-10.0, t)],
@@ -48,8 +54,8 @@ def main():
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
     assert solver._fused_residual is not None, "3rd-order path should fuse"
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100))
+    solver.fit(tf_iter=args.adam or scaled(args, 10_000, 200),
+               newton_iter=args.newton or scaled(args, 10_000, 100))
 
     x = domain.linspace("x")
     t = domain.linspace("t")

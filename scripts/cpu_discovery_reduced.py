@@ -1,0 +1,56 @@
+"""Reduced Allen-Cahn coefficient discovery on CPU (evidence hedge).
+
+Full config (512x201 grid, 4x128, 10k Adam — reference AC-discovery.py) is
+TPU-queue step C; this reduced run ([::4] subsampled 128x51 grid, 4x64 net,
+SA col_weights, 6000 Adam) demonstrates honest coefficient recovery for the
+inverse solver on one CPU core.  True values: c1 = 0.0001, c2 = 5.0.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/cpu_discovery_reduced.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from tensordiffeq_tpu import DiscoveryModel, grad
+from tensordiffeq_tpu.exact import allen_cahn_solution
+
+
+def main():
+    x, t, usol = allen_cahn_solution()
+    x, t, usol = x[::4], t[::4], usol[::4, ::4]
+    X = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_star = usol.reshape(-1, 1)
+
+    def f_model(u, var, x, t):
+        c1, c2 = var
+        u_xx = grad(grad(u, "x"), "x")
+        uv = u(x, t)
+        return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * uv ** 3 - c2 * uv
+
+    rng = np.random.RandomState(0)
+    model = DiscoveryModel()
+    model.compile([2, 64, 64, 64, 64, 1], f_model,
+                  [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
+                  col_weights=rng.rand(X.shape[0], 1), varnames=["x", "t"])
+    t0 = time.time()
+    model.fit(tf_iter=6_000)
+    wall = time.time() - t0
+
+    c1, c2 = (float(v) for v in model.vars)
+    out = {"grid": f"{len(x)}x{len(t)}", "net": "2-64x4-1", "adam": 6_000,
+           "c1": c1, "c1_true": 0.0001, "c2": c2, "c2_true": 5.0,
+           "c2_rel_err": abs(c2 - 5.0) / 5.0, "wall_s": round(wall, 1)}
+    print(json.dumps(out), flush=True)
+    with open(os.path.join(ROOT, "runs", "cpu_discovery_reduced.json"),
+              "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,10 +11,26 @@ analysis of the compiled step) and ``mfu`` (achieved FLOP/s ÷ chip peak).
 
 Resilience: the measurement runs in a SUBPROCESS with a hard timeout — this
 host's TPU tunnel can hang or fail backend init (round-1 failure mode:
-"Unable to initialize backend 'axon'", BENCH_r01.json rc=1).  The supervisor
-retries once, then falls back to a CPU measurement tagged
-``"backend_note": "cpu-fallback"``, and on total failure still prints a JSON
-line with a ``diag`` field.  Exit code is always 0.
+"Unable to initialize backend 'axon'", BENCH_r01.json rc=1; round-2 failure
+mode: backend init hung for the full 1500 s worker budget and the driver
+killed the run, BENCH_r02.json rc=124).  The supervisor therefore works to
+a hard TOTAL wall budget (``BENCH_BUDGET`` env; default 1140 s for the
+driver's no-flag invocation) and spends it in stages:
+
+  1. PROBE — a tiny subprocess checks that the JAX backend initializes at
+     all (<=120 s).  A hung tunnel costs 2 minutes here, not 25.
+  2. LIVE — only if the probe saw a real accelerator: the measurement
+     worker runs with the remaining budget.  A successful TPU payload is
+     also persisted to ``BENCH_TPU_<mode>.json`` (same gate as
+     scripts/_promote.sh) so future outages can still report hardware
+     numbers.
+  3. CACHED — probe/live failed: the last-good on-hardware payload is
+     emitted IMMEDIATELY, tagged ``"backend_note": "tpu-cached-<date>"``,
+     with a fresh small CPU sanity measurement attached when the budget
+     allows (``cpu_sanity`` field).
+  4. CPU fallback / total-failure sentinel — only when no hardware payload
+     was ever captured.  Exit code is always 0; exactly one JSON line is
+     the last stdout line in every path.
 
 ``vs_baseline`` is the ratio to a reference-style TensorFlow-2 train step
 (same network, same residual via nested GradientTape, same dual-Adam SA
@@ -50,6 +66,12 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(REPO, "BENCH_BASELINE_CACHE.json")
+# Directory holding BENCH_TPU_<mode>.json last-good hardware payloads
+# (module-level so tests can point it at a tmp dir).
+TPU_CACHE_DIR = REPO
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+# Wall-clock reserved for the cached-emit path after a live attempt fails.
+RESERVE_S = 45
 
 EPS = 0.0001  # Allen-Cahn diffusion coefficient
 
@@ -619,12 +641,101 @@ def last_json_line(text):
     return None
 
 
-def run_worker(flags, timeout):
+def probe_backend(timeout):
+    """Subprocess probe: which JAX backend initializes within ``timeout``?
+
+    Returns the backend name ("tpu"/"cpu"/...) or None on hang/crash.  This
+    is the 2-minute answer to the round-2 failure mode where backend init
+    hung for the worker's entire 1500 s budget (BENCH_r02.json)."""
+    code = "import jax; jax.devices(); print(jax.default_backend())"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"[probe] backend init hung >{timeout:.0f}s")
+        return None
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        log(f"[probe] backend init failed: {' | '.join(tail)}")
+        return None
+    out = (proc.stdout or "").strip().splitlines()
+    backend = out[-1] if out else None
+    log(f"[probe] backend = {backend}")
+    return backend
+
+
+def mode_name(mode_flags):
+    return mode_flags[0].lstrip("-") if mode_flags else "default"
+
+
+def tpu_cache_file(mode_flags):
+    return os.path.join(TPU_CACHE_DIR,
+                        f"BENCH_TPU_{mode_name(mode_flags)}.json")
+
+
+def load_cached_tpu(mode_flags):
+    """Last-good on-hardware payload for this mode, tagged as cached, or
+    None.  Only real-TPU artifacts are ever stored here (same gate as
+    scripts/_promote.sh), but re-check to be safe."""
+    path = tpu_cache_file(mode_flags)
+    if not os.path.exists(path):
+        return None
+    try:
+        payload = last_json_line(open(path).read())
+    except OSError:
+        return None
+    if not payload or payload.get("backend") != "tpu" \
+            or "backend_note" in payload:
+        return None
+    day = time.strftime("%Y-%m-%d", time.gmtime(os.path.getmtime(path)))
+    payload["backend_note"] = f"tpu-cached-{day}"
+    return payload
+
+
+def save_tpu_cache(mode_flags, payload):
+    """Persist a live hardware payload as the mode's last-good artifact —
+    the same acceptance rule as scripts/_promote.sh: real TPU backend, no
+    fallback sentinel, and a partial sweep never replaces a complete one."""
+    if payload.get("backend") != "tpu" or "backend_note" in payload:
+        return
+    # Partial sweeps are never cached here: seeding one would trip the
+    # watcher's [ -s BENCH_TPU_<m>.json ] idempotency guards and block the
+    # complete run forever.  Partials still reach artifacts through
+    # scripts/_promote.sh, whose gap-filling rule the watcher understands.
+    if "partial" in payload:
+        return
+    path = tpu_cache_file(mode_flags)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload) + "\n")
+        os.replace(tmp, path)
+        log(f"[supervisor] cached hardware payload -> {path}")
+    except OSError as e:
+        log(f"[supervisor] cache write failed: {e}")
+
+
+def cpu_sanity(timeout):
+    """Fresh small CPU measurement (BENCH_FAST config) to attach alongside a
+    cached hardware payload — proves the code still runs end-to-end today
+    even when the tunnel doesn't."""
+    env = dict(os.environ, BENCH_FAST="1", JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    payload, err = run_worker(["--force-cpu"], timeout, env=env)
+    if payload is None:
+        return {"error": err}
+    return {k: payload.get(k) for k in
+            ("metric", "value", "unit", "backend", "loss")
+            if k in payload}
+
+
+def run_worker(flags, timeout, env=None):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + flags
-    log(f"[supervisor] running {' '.join(cmd)} (timeout {timeout}s)")
+    log(f"[supervisor] running {' '.join(cmd)} (timeout {timeout:.0f}s)")
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, cwd=REPO)
+                              timeout=timeout, cwd=REPO, env=env)
     except subprocess.TimeoutExpired as e:
         # salvage streamed partial payloads (e.g. --scale prints one line
         # per completed sweep point) before declaring the attempt dead
@@ -674,29 +785,61 @@ def main():
 
     mode_flags = [f for f in ("--full", "--engines", "--precision", "--scale")
                   if getattr(args, f.lstrip("-"))]
-    default_to = 3600 if args.full else (3000 if args.scale else 1500)
-    timeout_s = int(os.environ.get("BENCH_TIMEOUT", default_to))
+
+    # Total wall budget.  The driver's no-flag invocation must finish well
+    # inside its window (round 2 proved >~25 min gets killed, rc=124); the
+    # explicit modes are watcher-driven with generous budgets of their own.
+    default_budget = {"default": 1140, "engines": 2400, "precision": 2400,
+                      "scale": 7200, "full": 86400}[mode_name(mode_flags)]
+    budget = float(os.environ.get("BENCH_BUDGET", default_budget))
+    t_start = time.time()
+
+    def remaining():
+        return budget - (time.time() - t_start)
+
+    # per-attempt cap still honored for the watcher scripts that set it
+    attempt_cap = float(os.environ.get("BENCH_TIMEOUT", budget))
 
     diag = []
-    # retry keeps the full budget in --full mode (a complete training run
-    # can never finish inside a 600s cap); throughput modes retry shorter
-    retry_to = timeout_s if args.full else min(600, timeout_s)
-    attempts = [([], timeout_s), ([], retry_to)]
-    for i, (flags, to) in enumerate(attempts):
-        payload, err = run_worker(mode_flags + flags, to)
-        if payload is not None:
-            if diag:
-                payload["diag"] = diag
-            print(json.dumps(payload))
-            return
-        diag.append(err)
-        log(f"[supervisor] attempt failed: {err}")
-        if "timed out" in err:
-            # an init hang will hang again — go straight to the CPU fallback
-            break
+    backend = probe_backend(min(PROBE_TIMEOUT, max(10.0, remaining() - 30)))
+    if backend and backend != "cpu":
+        to = min(attempt_cap, remaining() - RESERVE_S)
+        if to > 30:
+            payload, err = run_worker(mode_flags, to)
+            if payload is not None:
+                save_tpu_cache(mode_flags, payload)
+                if diag:
+                    payload["diag"] = diag
+                print(json.dumps(payload))
+                return
+            diag.append(err)
+            log(f"[supervisor] live attempt failed: {err}")
+        else:
+            diag.append("no budget left for a live attempt after probe")
+    else:
+        diag.append(f"backend probe: {backend or 'hang/failure'}")
+
+    # Tunnel down or live attempt failed: emit the last-good hardware
+    # payload NOW — the scoreboard must never be empty when real numbers
+    # exist (VERDICT r2 item 1).  The backend_note tag keeps promotion
+    # scripts from mistaking this for a fresh measurement.
+    cached = load_cached_tpu(mode_flags)
+    if cached is not None:
+        cached["diag"] = diag
+        if remaining() > 240:
+            cached["cpu_sanity"] = cpu_sanity(remaining() - 30)
+        print(json.dumps(cached))
+        return
+    diag.append("no cached hardware payload for this mode")
 
     log("[supervisor] falling back to CPU measurement")
-    payload, err = run_worker(mode_flags + ["--force-cpu"], timeout_s)
+    to = min(attempt_cap, remaining() - 15)
+    payload = err = None
+    if to > 60:
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        payload, err = run_worker(mode_flags + ["--force-cpu"], to, env=env)
+    else:
+        err = "no budget left for a CPU fallback"
     if payload is not None:
         payload["backend_note"] = "cpu-fallback"
         payload["diag"] = diag

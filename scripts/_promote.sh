@@ -9,6 +9,14 @@
 # Anything else stays in runs/<name>.new for diagnosis and never clobbers a
 # previously captured artifact.
 
+# have_complete <name> — true when the canonical artifact exists AND is not
+# a partial sweep.  Guards that used a bare [ -s ... ] would treat a promoted
+# gap-filler partial as done forever and never re-attempt the complete run
+# after the tunnel recovers (advisor finding, round 2).
+have_complete() {
+    [ -s "BENCH_TPU_$1.json" ] && ! grep -q '"partial"' "BENCH_TPU_$1.json"
+}
+
 promote() {
     local name="$1" new="runs/$1.new"
     [ -s "$new" ] || { echo "[$name] no output, NOT promoted"; return 1; }

@@ -49,11 +49,13 @@ elif healthy; then
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== D. single-chip N_f scaling sweep (50k..500k) ==="
-if [ -s BENCH_TPU_scale.json ]; then echo "done already"
+# have_complete (not a bare -s test): a promoted partial sweep must be
+# re-attempted once the tunnel recovers (advisor finding, round 2)
+if have_complete scale; then echo "done already"
 elif healthy; then
     # internal budget 1500s/attempt: TPU attempt + CPU fallback both fit
     # inside the outer guard with headroom for compiles
-    BENCH_TIMEOUT=1500 timeout 4800 python bench.py --scale \
+    BENCH_BUDGET=4600 BENCH_TIMEOUT=1500 timeout 4800 python bench.py --scale \
         > runs/scale.new 2> runs/bench_scale_tpu.log
     promote scale
 else echo "SKIP: tunnel unhealthy"; fi

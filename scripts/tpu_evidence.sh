@@ -17,8 +17,10 @@ echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
 echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
-if [ -s BENCH_TPU_full.json ]; then echo "already captured"; else
-    BENCH_TIMEOUT=5400 timeout 5500 python bench.py --full \
+# BENCH_BUDGET sits inside the outer timeout so bench.py always gets to
+# print its JSON line (and salvage partials) before the external kill
+if have_complete full; then echo "already captured"; else
+    BENCH_BUDGET=5300 BENCH_TIMEOUT=5100 timeout 5500 python bench.py --full \
         > runs/full.new 2> runs/ac_sa_full_tpu.log
     promote full
 fi
@@ -30,14 +32,16 @@ timeout 1800 python bench.py > runs/default.new 2> runs/bench_default_tpu.log
 promote default
 
 echo "=== 3. precision axis (incl bf16-taylor) ==="
-if [ -s BENCH_TPU_precision.json ]; then echo "already captured"; else
-    timeout 2500 python bench.py --precision > runs/precision.new 2> runs/bench_precision_tpu.log
+if have_complete precision; then echo "already captured"; else
+    BENCH_BUDGET=2300 timeout 2500 python bench.py --precision \
+        > runs/precision.new 2> runs/bench_precision_tpu.log
     promote precision
 fi
 
 echo "=== 4. engines ==="
 # always re-run (old artifact lacks the backend field); promote-gated
-timeout 1800 python bench.py --engines > runs/engines.new 2> runs/bench_engines_tpu.log
+BENCH_BUDGET=1700 timeout 1800 python bench.py --engines \
+    > runs/engines.new 2> runs/bench_engines_tpu.log
 promote engines
 
 echo "=== 5. on-hardware kernel parity tests ==="

@@ -8,6 +8,15 @@ cd "$(dirname "$0")/.."
 mkdir -p runs
 STATE=runs/tpu_watch.state
 
+# Singleton guard: two watchers racing the evidence suite on this 1-core
+# host would double every run and race the promote step (round-3 cleanup:
+# two instances were found running).  flock on fd 9 held for process life.
+exec 9>runs/tpu_watch.lock
+if ! flock -n 9; then
+    echo "another tpu_watch.sh holds runs/tpu_watch.lock; exiting" >&2
+    exit 0
+fi
+
 while true; do
     echo "probing $(date +%H:%M:%S)" > "$STATE"
     if timeout 120 python -c "

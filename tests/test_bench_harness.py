@@ -86,6 +86,40 @@ def test_promote_rejects_sentinels_and_cpu(tmp_path):
         assert json.loads(final)["value"] == 5  # artifact untouched
 
 
+def test_tpu_cache_roundtrip_and_tagging(tmp_path):
+    bench = _load_bench()
+    bench.TPU_CACHE_DIR = str(tmp_path)
+    assert bench.load_cached_tpu([]) is None  # no file yet
+    # a live TPU payload is persisted and comes back tagged as cached
+    bench.save_tpu_cache([], {"value": 7, "backend": "tpu"})
+    got = bench.load_cached_tpu([])
+    assert got["value"] == 7
+    assert got["backend_note"].startswith("tpu-cached-")
+    # modes map to distinct artifacts
+    assert bench.mode_name(["--scale"]) == "scale"
+    assert bench.load_cached_tpu(["--scale"]) is None
+
+
+def test_tpu_cache_rejects_non_hardware(tmp_path):
+    bench = _load_bench()
+    bench.TPU_CACHE_DIR = str(tmp_path)
+    # same gate as scripts/_promote.sh: no cpu, no sentinel tags
+    bench.save_tpu_cache([], {"value": 1, "backend": "cpu"})
+    bench.save_tpu_cache([], {"value": 2, "backend": "tpu",
+                              "backend_note": "cpu-fallback"})
+    assert bench.load_cached_tpu([]) is None
+    # partial sweeps are never cached (they would trip the watcher's
+    # already-captured guards and block the complete run forever)
+    bench.save_tpu_cache([], {"value": 3, "backend": "tpu", "partial": "t/o"})
+    assert bench.load_cached_tpu([]) is None
+    bench.save_tpu_cache([], {"value": 5, "backend": "tpu"})
+    assert bench.load_cached_tpu([])["value"] == 5
+    # ... and a cached payload re-saved must not re-enter the cache
+    cached = bench.load_cached_tpu([])
+    bench.save_tpu_cache([], cached)
+    assert bench.load_cached_tpu([])["value"] == 5
+
+
 def test_promote_partial_only_fills_gaps(tmp_path):
     partial = '{"value": 3, "backend": "tpu", "partial": "timed out"}'
     # never replaces a complete artifact ...
@@ -95,3 +129,23 @@ def test_promote_partial_only_fills_gaps(tmp_path):
     # ... but is better than nothing
     rc, final = _promote(tmp_path, "w", partial)
     assert rc == 0 and json.loads(final)["value"] == 3
+
+
+def test_have_complete_rechecks_partials(tmp_path):
+    # the watcher's already-captured guard must re-run a promoted partial
+    (tmp_path / "scripts").mkdir(exist_ok=True)
+    src = os.path.join(REPO, "scripts", "_promote.sh")
+    (tmp_path / "scripts" / "_promote.sh").write_text(open(src).read())
+
+    def have(name):
+        return subprocess.run(
+            ["bash", "-c", f". scripts/_promote.sh && have_complete {name}"],
+            cwd=tmp_path).returncode == 0
+
+    assert not have("q")  # no artifact
+    (tmp_path / "BENCH_TPU_q.json").write_text(
+        '{"value": 3, "backend": "tpu", "partial": "timed out"}')
+    assert not have("q")  # partial: re-attempt
+    (tmp_path / "BENCH_TPU_q.json").write_text(
+        '{"value": 5, "backend": "tpu"}')
+    assert have("q")  # complete: skip

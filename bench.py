@@ -688,7 +688,10 @@ def load_cached_tpu(mode_flags):
     if not payload or payload.get("backend") != "tpu" \
             or "backend_note" in payload:
         return None
-    day = time.strftime("%Y-%m-%d", time.gmtime(os.path.getmtime(path)))
+    # prefer the capture date stored in the payload — file mtime is reset
+    # by checkouts/copies and would stamp an old measurement as fresh
+    day = payload.get("captured") or time.strftime(
+        "%Y-%m-%d", time.gmtime(os.path.getmtime(path)))
     payload["backend_note"] = f"tpu-cached-{day}"
     return payload
 
@@ -709,7 +712,8 @@ def save_tpu_cache(mode_flags, payload):
     try:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            f.write(json.dumps(payload) + "\n")
+            f.write(json.dumps(
+                dict(payload, captured=time.strftime("%Y-%m-%d"))) + "\n")
         os.replace(tmp, path)
         log(f"[supervisor] cached hardware payload -> {path}")
     except OSError as e:

@@ -29,10 +29,11 @@ print('healthy')
         bash scripts/tpu_evidence.sh >> runs/tpu_evidence_watch.log 2>&1
         bash scripts/tpu_convergence_extra.sh >> runs/tpu_extra_watch.log 2>&1
         # a mid-suite tunnel death leaves gaps — keep watching until the
-        # core artifacts exist (the suite skips/refuses already-done steps'
-        # clobbering, so a re-pass only fills what is missing)
-        if [ -s BENCH_TPU_full.json ] && [ -s BENCH_TPU_default.json ] \
-            && [ -s BENCH_TPU_precision.json ] && [ -s BENCH_TPU_engines.json ] \
+        # core artifacts exist AND are complete (have_complete: a promoted
+        # gap-filler partial must keep the watcher alive for the re-run)
+        . scripts/_promote.sh
+        if have_complete full && have_complete default \
+            && have_complete precision && have_complete engines \
             && grep -q "passed" runs/hwtests_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/ac_baseline_full_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/burgers_full_tpu.log 2>/dev/null \

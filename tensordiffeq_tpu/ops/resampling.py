@@ -49,6 +49,13 @@ def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
     s = np.abs(np.asarray(scores, np.float64)).ravel()
     if n_keep >= s.size:
         return np.arange(s.size)
+    # normalize before exponentiating: s**temp can overflow to inf for
+    # extreme residuals with temp>1, which would silently disable the
+    # importance weighting exactly when residuals are most informative
+    # (advisor finding, round 2); p is scale-invariant after the /tot below
+    smax = s.max()
+    if smax > 0.0 and np.isfinite(smax):
+        s = s / smax
     p = s ** temp
     tot = p.sum()
     if not np.isfinite(tot) or tot <= 0.0:
@@ -87,7 +94,16 @@ def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
     n_pool = max(int(pool_factor) * n_f, n_f)
     if placement is not None and getattr(placement, "mesh", None) is not None:
         n_dev = int(np.prod(placement.mesh.devices.shape))
-        n_pool -= n_pool % n_dev  # pool shards evenly, scoring rides the mesh
+        # fail at build time, not mid-training: the selected X_new has n_f
+        # rows and must device_put onto the mesh, so n_f itself (not just
+        # the pool) has to shard evenly (advisor finding, round 2 — the
+        # earlier fix only rounded the pool and moved the shape error two
+        # lines down).  n_pool = pool_factor*n_f is then divisible too.
+        if n_f % n_dev:
+            raise ValueError(
+                f"n_f={n_f} must be divisible by the mesh device count "
+                f"{n_dev} for resampling under dist=True")
+    assert n_pool >= n_f, (n_pool, n_f)
 
     if jax.process_count() > 1:
         raise NotImplementedError(

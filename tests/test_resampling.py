@@ -34,6 +34,45 @@ def test_importance_select_concentrates_and_covers():
     assert importance_select(np.ones(5), 5).tolist() == [0, 1, 2, 3, 4]
 
 
+def test_importance_select_survives_extreme_scores():
+    """s**temp used to overflow to inf for huge residuals with temp>1 and
+    silently fall back to a uniform draw — importance sampling disabled
+    exactly when residuals were most extreme (advisor finding, round 2)."""
+    rng = np.random.default_rng(0)
+    scores = np.full(10_000, 1e200)
+    scores[:1_000] = 1e210  # 10x hotter; (1e210)**2 overflows float64
+    idx = importance_select(scores, 2_000, temp=2.0, uniform_frac=0.1,
+                            rng=rng)
+    hot = (idx < 1_000).mean()
+    assert hot > 0.4  # still concentrated, not the uniform fallback's ~10%
+
+
+def test_resampler_mesh_divisibility_validated_up_front(eight_devices):
+    """pool_factor=1 with an n_f the mesh doesn't divide used to round the
+    pool DOWN below n_f and die as a shape error mid-training (advisor
+    finding, round 2).  A non-divisible n_f can never produce a shardable
+    X_new, so the builder must reject it at build time; a divisible n_f
+    must work at pool_factor=1 through a real NamedSharding."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    solver = _burgers_solver(n_f=640, dist=True)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    like = jax.device_put(jnp.zeros((640, 2), jnp.float32), sharding)
+
+    with pytest.raises(ValueError, match="divisible"):
+        make_residual_resampler(solver._residual_jit, solver.domain.xlimits,
+                                601, pool_factor=1, like=like, seed=1)
+
+    resample = make_residual_resampler(
+        solver._residual_jit, solver.domain.xlimits, 640,
+        pool_factor=1, like=like, seed=1)
+    X_new = resample(solver.params, epoch=0)
+    assert X_new.shape == (640, 2)
+    assert X_new.sharding.is_equivalent_to(sharding, 2)
+
+
 def test_residual_scores_sums_outputs_and_tuples():
     def res_single(params, X):
         return X[:, :1] * 2.0

@@ -1,0 +1,20 @@
+# Container parity with the reference's Dockerfile (/root/reference/
+# Dockerfile:1), retargeted from CUDA/TF to the JAX TPU stack: on a Cloud
+# TPU VM the libtpu runtime is provided by the `jax[tpu]` extra.
+FROM python:3.12-slim
+
+# g++ builds the native ESE sampler lazily on first use
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/tensordiffeq-tpu
+COPY pyproject.toml README.md ./
+COPY tensordiffeq_tpu ./tensordiffeq_tpu
+
+# CPU wheels by default; on a TPU VM build with:
+#   --build-arg JAX_EXTRA="jax[tpu] -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+ARG JAX_EXTRA="jax"
+RUN pip install --no-cache-dir ${JAX_EXTRA} && \
+    pip install --no-cache-dir ".[all]"
+
+CMD ["python", "-c", "import tensordiffeq_tpu as tdq; print(tdq.__doc__.splitlines()[0])"]

@@ -41,4 +41,4 @@ from .networks import MLP, neural_net  # noqa: F401
 from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # kept in sync with pyproject.toml

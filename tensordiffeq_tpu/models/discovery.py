@@ -180,14 +180,22 @@ class DiscoveryModel:
                                    requests, precision=self.net.precision,
                                    has_prefix_arg=True, return_primal=True)
 
+    def _generic_residual(self, params, vars_, X):
+        """The one generic (autodiff) construction of ``f_model(u, var, ·)``
+        — serves training's fallback path, the fused cross-check, and
+        :meth:`predict_f`, so the residual they evaluate can never drift
+        apart."""
+        u = make_ufn(self.apply_fn, params, self.varnames, self.n_out)
+        return vmap_residual(
+            lambda u_, *coords: self.f_model(u_, vars_, *coords),
+            u, self.ndim)(X)
+
     def _crosscheck_fused(self, n_check: int = 32):
         from ..ops.fused import crosscheck_residuals
 
         X_s = self.X[: min(n_check, int(self.X.shape[0]))]
         vars0 = self.trainables["vars"]
-        u = make_ufn(self.apply_fn, self.params, self.varnames, self.n_out)
-        generic = vmap_residual(
-            lambda u_, *c: self.f_model(u_, vars0, *c), u, self.ndim)(X_s)
+        generic = self._generic_residual(self.params, vars0, X_s)
         try:
             fused, u_primal = self._fused_residual(self.params, X_s, vars0)
         except Exception as e:
@@ -203,9 +211,9 @@ class DiscoveryModel:
 
     # ------------------------------------------------------------------ #
     def _build(self):
-        X, u_data, ndim = self.X, self.u_data, self.ndim
-        apply_fn, varnames, n_out = self.apply_fn, self.varnames, self.n_out
-        f_model = self.f_model
+        X, u_data = self.X, self.u_data
+        apply_fn = self.apply_fn
+        generic_residual = self._generic_residual
 
         self._fused_residual = self._try_fuse() if self.fused is not False \
             else None
@@ -241,10 +249,7 @@ class DiscoveryModel:
                 f_pred, u_pred = fused_res(tr["params"], X, tr["vars"])
             else:
                 u_pred = apply_fn(tr["params"], X)
-                u = make_ufn(apply_fn, tr["params"], varnames, n_out)
-                f_pred = vmap_residual(
-                    lambda u_, *coords: f_model(u_, tr["vars"], *coords),
-                    u, ndim)(X)
+                f_pred = generic_residual(tr["params"], tr["vars"], X)
             preds = f_pred if isinstance(f_pred, tuple) else (f_pred,)
             data_loss = MSE(u_pred, u_data)
             comps = {"Data": data_loss}
@@ -355,3 +360,16 @@ class DiscoveryModel:
     def predict(self, X_star):
         X_star = jnp.asarray(X_star, jnp.float32)
         return np.asarray(self.apply_fn(self.trainables["params"], X_star))
+
+    def predict_f(self, X_star):
+        """Residual of the learned PDE at ``X_star`` under the CURRENT
+        coefficient estimates — the load-and-evaluate flow of the
+        reference's ``examples/AC-inference.py:18-26`` (build ``f_model``
+        with tunable ``var``, then evaluate it on a restored model).
+        Returns one ``[n, 1]`` array per residual equation."""
+        X_star = jnp.asarray(X_star, jnp.float32)
+        f = self._generic_residual(self.trainables["params"],
+                                   self.trainables["vars"], X_star)
+        if isinstance(f, tuple):
+            return tuple(np.asarray(p).reshape(-1, 1) for p in f)
+        return np.asarray(f).reshape(-1, 1)

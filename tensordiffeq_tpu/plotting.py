@@ -100,16 +100,24 @@ def plot_solution_domain1D(model, domain: Sequence[np.ndarray], ub, lb,
 
 def plot_weights(model, scale: float = 1.0, save_path: Optional[str] = None):
     """Scatter of SA collocation weights over the domain
-    (reference ``plotting.py:130-132``)."""
+    (reference ``plotting.py:130-132``).  Accepts the forward solver
+    (per-point residual λ over ``X_f``) AND the DiscoveryModel (SA
+    ``col_weights`` over the observation grid — the reference's
+    ``AC-inference.py:69`` calls this on a DiscoveryModel and its own
+    implementation 'doesnt work quite yet'; this one does)."""
     plt = _plt()
     lam = None
-    for cand in model.lambdas.get("residual", []):
-        if cand is not None:
-            lam = np.asarray(cand)
-            break
+    if getattr(model, "col_weights", None) is not None:  # DiscoveryModel
+        lam = np.asarray(model.col_weights)
+        X_f = np.asarray(model.X)
+    elif hasattr(model, "lambdas"):  # forward solver
+        for cand in model.lambdas.get("residual", []):
+            if cand is not None:
+                lam = np.asarray(cand)
+                break
+        X_f = np.asarray(model.X_f)
     if lam is None:
         raise ValueError("model has no adaptive residual weights to plot")
-    X_f = np.asarray(model.X_f)
     fig, ax = plt.subplots()
     sc = ax.scatter(X_f[:, 1], X_f[:, 0], c=lam.ravel() * scale, s=2,
                     cmap="viridis")

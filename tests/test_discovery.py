@@ -60,6 +60,26 @@ def test_discovery_predict():
     assert pred.shape == (100, 1)
 
 
+def test_discovery_predict_f_uses_current_vars():
+    """predict_f (the AC-inference load-and-evaluate flow) must evaluate the
+    residual with the CURRENT coefficient estimates: with the true c the
+    residual of good data is small; with a wrong c it is provably larger."""
+    x, t, u = synthetic_heat_data(n=150)
+    model = DiscoveryModel()
+    model.compile([2, 20, 20, 1], f_model, [x, t], u, var=[0.0],
+                  varnames=["x", "t"], verbose=False)
+    model.fit(tf_iter=1500, chunk=500)
+    X = np.hstack([x, t])
+    f_trained = model.predict_f(X)
+    assert f_trained.shape == (150, 1) and np.isfinite(f_trained).all()
+    # corrupt the coefficient: the same network now violates ITS pde harder
+    import jax.numpy as jnp
+    good = model.trainables["vars"]
+    model.trainables["vars"] = [jnp.asarray(float(good[0]) + 1.0)]
+    f_wrong = model.predict_f(X)
+    assert np.abs(f_wrong).mean() > 3 * np.abs(f_trained).mean()
+
+
 def test_discovery_accepts_stacked_X():
     x, t, u = synthetic_heat_data(n=64)
     model = DiscoveryModel()

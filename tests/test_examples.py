@@ -38,6 +38,13 @@ def test_checkpoint_transfer_example_runs(tmp_path):
     run_example("transfer_learn.py")
 
 
+def test_inference_example_restores_and_evaluates(tmp_path):
+    # the load-and-evaluate flow (reference AC-inference.py): fresh model,
+    # restored state, coefficients + residual + weight plot
+    run_example("ac_inference.py", "--plot", str(tmp_path))
+    assert (tmp_path / "ac_inference_weights.png").exists()
+
+
 def test_kdv_example_runs():
     """KdV: third-order derivative path end-to-end (fused engine)."""
     run_example("kdv.py")

@@ -14,13 +14,26 @@ The reference runs UNMODIFIED from /root/reference via PYTHONPATH, with
 one harness shim: ``tensorflow_probability`` is absent from this image
 and the reference imports it at module scope (``optimizers.py:5``)
 even though its default L-BFGS path is the eager one that never uses
-it — a no-op stub module is injected so the import succeeds.  Its Adam
-phase is driven in chunks through its own public ``fit`` so rel-L2 can
-be sampled on the same wall clock; optimizer state lives on the model
-object, so chunking does not reset it (``models.py`` keeps
-``tf_optimizer`` across fit calls).  The L-BFGS phase runs as one
-uninterrupted call (its eager loop owns the iteration) and is evaluated
-at the end.
+it — a no-op stub module is injected so the import succeeds.
+
+Fairness accounting (every correction here favors the REFERENCE, so the
+reported speedup is a lower bound):
+
+* The reference's Adam is driven in 1000-iter chunks through its own
+  public ``fit`` so rel-L2 can be sampled (optimizer state lives on the
+  model object and persists) — but each ``fit`` call re-wraps the grad
+  step in a fresh ``tf.function`` (reference ``fit.py:35``), a re-trace
+  an unchunked run pays once.  The harness measures that marginal
+  per-call cost with two 1-iter warm-up fits and CREDITS it back: every
+  reference timeline point is reported at
+  ``t_raw - (fit_calls_so_far - 1) * retrace``.
+* The reference's eager L-BFGS owns its loop, so rel-L2 is only
+  observable at the end.  If the bar is first crossed by that final
+  evaluation, the reference's ``time_to_bar`` is recorded as the
+  L-BFGS phase START time — i.e. the reference is assumed to have
+  crossed the bar the moment the phase began.
+* Our run evaluates every 500 iters of BOTH phases (denser eval than
+  the reference pays), included in our clock.
 
 Usage:  python scripts/head_to_head.py [--adam N] [--newton N] [--which both|tf|jax]
 Writes runs/head_to_head.json (merging, so tf/jax can run separately).
@@ -38,7 +51,8 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "runs", "head_to_head.json")
 BAR = 5e-2
-ADAM_CHUNK = 500
+ADAM_CHUNK = 1000
+EVAL_EVERY_OURS = 500
 
 
 def ground_truth():
@@ -146,23 +160,58 @@ def run_reference(adam_iter, newton_iter):
 
     timeline = []
     t0 = time.time()
-    done = 0
+    # marginal cost of one extra fit() call (fresh tf.function re-trace of
+    # the grad step, fit.py:35) — measured, then credited back to every
+    # reference timestamp so chunked eval doesn't bill the reference for
+    # overhead an unchunked run would not pay
+    model.fit(tf_iter=1, newton_iter=0)
+    t1 = time.time()
+    model.fit(tf_iter=1, newton_iter=0)
+    retrace = time.time() - t1
+    print(f"[h2h] reference per-fit-call retrace cost: {retrace:.1f}s "
+          "(credited back)", flush=True)
+    fit_calls = 2
+    done = 2
+
+    def t_adj():
+        return time.time() - t0 - (fit_calls - 1) * retrace
+
     while done < adam_iter:
         n = min(ADAM_CHUNK, adam_iter - done)
         model.fit(tf_iter=n, newton_iter=0)
+        fit_calls += 1
         done += n
         u_pred, _ = model.predict(X_star)
-        record(timeline, time.time() - t0, rel_l2(np.asarray(u_pred), u_star),
+        record(timeline, t_adj(), rel_l2(np.asarray(u_pred), u_star),
                f"adam@{done}")
+    t_lbfgs_start = None
     if newton_iter:
+        t_lbfgs_start = t_adj()
         model.fit(tf_iter=0, newton_iter=newton_iter)
+        fit_calls += 1
         u_pred, _ = model.predict(X_star)
-        record(timeline, time.time() - t0,
+        record(timeline, t_adj(),
                rel_l2(np.asarray(u_pred), u_star), f"lbfgs@{newton_iter}")
-    wall = time.time() - t0
-    return {"framework": "reference-tf", "wall": round(wall, 1),
-            "final_l2": timeline[-1]["l2"], "best_l2": min(p["l2"] for p in timeline),
-            "time_to_bar": time_to_bar(timeline), "timeline": timeline}
+    wall = t_adj()
+    ttb = time_to_bar(timeline)
+    note = None
+    if (ttb is not None and t_lbfgs_start is not None
+            and all(p["l2"] > BAR for p in timeline[:-1])
+            and timeline[-1]["l2"] <= BAR):
+        # only the un-observable L-BFGS phase crossed the bar: credit the
+        # reference with crossing at the phase START (lower bound)
+        ttb = round(t_lbfgs_start, 1)
+        note = ("bar first crossed inside the eager L-BFGS phase (end-only "
+                "observable); time_to_bar conservatively set to the phase "
+                "start")
+    out = {"framework": "reference-tf", "wall": round(wall, 1),
+           "retrace_credit_per_call": round(retrace, 1),
+           "final_l2": timeline[-1]["l2"],
+           "best_l2": min(p["l2"] for p in timeline),
+           "time_to_bar": ttb, "timeline": timeline}
+    if note:
+        out["time_to_bar_note"] = note
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -202,7 +251,7 @@ def run_ours(adam_iter, newton_iter):
                f"{phase}@{step}")
 
     solver.fit(tf_iter=adam_iter, newton_iter=newton_iter,
-               eval_fn=eval_fn, eval_every=ADAM_CHUNK)
+               eval_fn=eval_fn, eval_every=EVAL_EVERY_OURS)
     wall = time.time() - t0
     u_pred, _ = solver.predict(X_star, best_model=True)
     best = rel_l2(u_pred, u_star)
@@ -219,15 +268,19 @@ def main():
     ap.add_argument("--which", choices=("both", "tf", "jax"), default="both")
     args = ap.parse_args()
 
+    config = {"n_f": 10_000, "net": "2-20x8-1",
+              "adam": args.adam, "newton": args.newton,
+              "bar": BAR, "host": "1 CPU core",
+              "truth": "reference burgers_shock.mat 256x100"}
     results = {}
     if os.path.exists(OUT):
         with open(OUT) as fh:
             results = json.load(fh)
-    results.setdefault("config",
-                       {"n_f": 10_000, "net": "2-20x8-1",
-                        "adam": args.adam, "newton": args.newton,
-                        "bar": BAR, "host": "1 CPU core",
-                        "truth": "reference burgers_shock.mat 256x100"})
+        if results.get("config") != config:
+            # a config change invalidates cross-run merging — start clean
+            # rather than attributing old timelines to the new config
+            results = {}
+    results["config"] = config
 
     def save():
         with open(OUT, "w") as fh:

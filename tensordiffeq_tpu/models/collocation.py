@@ -675,9 +675,18 @@ class CollocationSolverND:
                 # only when resampling: thread the LIVE collocation subsample
                 # into the residual traces so the balance follows each
                 # redraw.  The plain path keeps the compile-time points baked
-                # inside jit — an eager gather here would break multi-host
-                # dist meshes (X_f spans non-addressable devices), and
-                # resampling itself is gated to single-host.
+                # inside jit.  residual_subsample's eager gather reads the
+                # whole X_f on the host, which a cross-host array forbids —
+                # NTK + resampling together stay single-process for now
+                # (resampling alone is multi-host-safe, ops/resampling.py).
+                import jax as _jax
+                if _jax.process_count() > 1:
+                    raise NotImplementedError(
+                        "Adaptive_type=3 (NTK) combined with resample_every "
+                        "is not supported on a multi-process mesh: the NTK "
+                        "rebalance subsamples the live collocation set on "
+                        "the host, which cannot read a cross-host array. "
+                        "Drop one of the two, or run single-process.")
                 from ..ops.ntk import residual_subsample
 
                 def ntk_update(p):

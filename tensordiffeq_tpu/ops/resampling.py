@@ -9,8 +9,10 @@ residual is large.  This module adds that as a *redraw*, not a point-mover:
 * every ``resample_every`` epochs (at a chunk boundary of the jitted Adam
   scan), draw a fresh LHS **pool** of ``pool_factor x N_f`` candidates,
 * score the pool with the solver's compiled residual (one jitted forward,
-  data-parallel across a single host's mesh under ``dist=True``; scoring
-  gathers |f| to the host, so a multi-*host* mesh raises up front),
+  data-parallel across the mesh under ``dist=True``; on a multi-HOST mesh
+  every process draws the identical pool, scores its addressable shards,
+  and a ``process_allgather`` of the per-row scores makes the importance
+  selection bit-identical on all hosts — no cross-host array fetch),
 * keep ``N_f`` points by importance sampling ``p ∝ |f|^temp`` mixed with a
   ``uniform_frac`` floor (coverage never collapses onto one feature),
   drawn without replacement via the Gumbel top-k trick (O(pool), no
@@ -67,16 +69,56 @@ def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
     return np.argpartition(-keys, n_keep)[:n_keep]
 
 
+def _row_scores(values) -> np.ndarray:
+    """Per-row score of one residual block: |f| in float64, summed over
+    output columns.  The ONE reduction both the single-host and multi-host
+    scoring paths share — they must stay bitwise-identical for a resampled
+    run to reproduce across topologies (test_multihost asserts this)."""
+    a = np.abs(np.asarray(values, np.float64))
+    return a.reshape(a.shape[0], -1).sum(axis=1)
+
+
 def residual_scores(residual_fn: Callable, params, X) -> np.ndarray:
     """``[N]`` importance scores: |residual| summed over outputs/equations."""
     f = residual_fn(params, X)
     parts = f if isinstance(f, tuple) else (f,)
     s = None
     for part in parts:
-        a = np.abs(np.asarray(part, np.float64))
-        a = a.reshape(a.shape[0], -1).sum(axis=1)
+        a = _row_scores(part)
         s = a if s is None else s + a
     return s
+
+
+def _scores_multihost(residual_fn: Callable, params, X_global,
+                      n_pool: int) -> np.ndarray:
+    """``[n_pool]`` global scores when the pool spans multiple processes.
+
+    ``np.asarray`` on a cross-host array is illegal, so each process reads
+    only its addressable shards (row slices of the global pool), and the
+    (row, score) pairs ride ONE ``process_allgather`` — every process then
+    holds the full score vector and the subsequent seeded selection is
+    bit-identical everywhere."""
+    from jax.experimental import multihost_utils
+
+    f = residual_fn(params, X_global)
+    parts = f if isinstance(f, tuple) else (f,)
+    local: dict[int, np.ndarray] = {}
+    for part in parts:
+        for shard in part.addressable_shards:
+            a = _row_scores(shard.data)
+            start = shard.index[0].start or 0
+            local[start] = local.get(start, 0.0) + a
+    rows = np.concatenate([np.arange(s, s + v.size)
+                           for s, v in sorted(local.items())])
+    vals = np.concatenate([v for _, v in sorted(local.items())])
+    # one collective: rows ride along as a float64 lane (exact up to 2^53)
+    packed = np.stack([rows.astype(np.float64), vals])
+    packed_all = np.asarray(multihost_utils.process_allgather(packed))
+    packed_all = packed_all.reshape(-1, 2, packed.shape[1])
+    scores = np.zeros(n_pool, np.float64)
+    for block in packed_all:
+        scores[block[0].astype(np.int64)] = block[1]
+    return scores
 
 
 def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
@@ -105,28 +147,38 @@ def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
                 f"{n_dev} for resampling under dist=True")
     assert n_pool >= n_f, (n_pool, n_f)
 
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "adaptive resampling on a multi-host mesh is not supported yet: "
-            "pool scoring gathers |f| to the host, which cannot fetch a "
-            "cross-host array")
+    multihost = jax.process_count() > 1
+    if multihost and placement is None:
+        raise ValueError(
+            "multi-host resampling needs a sharded `like` array so the "
+            "fresh pool can be placed on the global mesh")
+
+    def _place(arr_np):
+        """float32 device array with the training placement.  Multi-host:
+        every process holds the identical numpy array, so assembling the
+        global array from per-shard row slices is consistent."""
+        arr_np = np.asarray(arr_np, np.float32)
+        if multihost:
+            return jax.make_array_from_callback(
+                arr_np.shape, placement, lambda idx: arr_np[idx])
+        out = jnp.asarray(arr_np)
+        return jax.device_put(out, placement) if placement is not None else out
 
     def resample(params, epoch: int) -> jnp.ndarray:
         # two decorrelated streams per redraw (pool LHS vs selection noise),
-        # both keyed on (seed, epoch) so distinct epochs explore new pools
+        # both keyed on (seed, epoch) so distinct epochs explore new pools —
+        # and therefore identical on every process of a multi-host mesh
         pool_ss, sel_ss = np.random.SeedSequence([seed, int(epoch)]).spawn(2)
         pool = LatinHypercubeSample(n_pool, xlimits, criterion="c",
                                     seed=int(pool_ss.generate_state(1)[0]))
-        pool_j = jnp.asarray(pool, jnp.float32)
-        if placement is not None:
-            pool_j = jax.device_put(pool_j, placement)
-        scores = residual_scores(residual_fn, params, pool_j)
+        pool_j = _place(pool)
+        if multihost:
+            scores = _scores_multihost(residual_fn, params, pool_j, n_pool)
+        else:
+            scores = residual_scores(residual_fn, params, pool_j)
         rng = np.random.default_rng(sel_ss)
         idx = importance_select(scores, n_f, temp=temp,
                                 uniform_frac=uniform_frac, rng=rng)
-        X_new = jnp.asarray(pool[np.sort(idx)], jnp.float32)
-        if placement is not None:
-            X_new = jax.device_put(X_new, placement)
-        return X_new
+        return _place(pool[np.sort(idx)])
 
     return resample

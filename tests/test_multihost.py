@@ -29,6 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = textwrap.dedent("""
     import os, sys
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "sa"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -40,8 +41,19 @@ WORKER = textwrap.dedent("""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mh_problem import build_solver
 
-    solver = build_solver(dist=True)
-    solver.fit(tf_iter=20, newton_iter=5)
+    if mode == "resample":
+        # adaptive redraw across a 2-process mesh: pool scoring must ride
+        # process_allgather (np.asarray on the global array is illegal)
+        solver = build_solver(dist=True, per_point=False)
+        X_orig = np.asarray(solver.X_f).copy()  # pre-fit: host array
+        solver.fit(tf_iter=20, newton_iter=0, chunk=5, resample_every=10)
+        sh = solver.X_f.addressable_shards[0]   # post-fit: global array
+        rows = sh.index[0]
+        assert not np.allclose(np.asarray(sh.data), X_orig[rows]), \\
+            "redraw did not replace points"
+    else:
+        solver = build_solver(dist=True)
+        solver.fit(tf_iter=20, newton_iter=5)
     tl = [d["Total Loss"] for d in solver.losses]
     assert all(np.isfinite(v) for v in tl), tl
     if pid == 0:
@@ -54,7 +66,7 @@ PROBLEM = textwrap.dedent("""
     from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
                                   periodicBC, grad)
 
-    def build_solver(dist):
+    def build_solver(dist, per_point=True):
         domain = DomainND(["x", "t"], time_var="t")
         domain.add("x", [-1.0, 1.0], 64)
         domain.add("t", [0.0, 1.0], 16)
@@ -77,12 +89,16 @@ PROBLEM = textwrap.dedent("""
 
         rng = np.random.RandomState(0)
         solver = CollocationSolverND(verbose=False)
-        solver.compile(
-            [2, 16, 16, 1], f_model, domain, bcs, Adaptive_type=1,
-            dict_adaptive={"residual": [True], "BCs": [True, False]},
-            init_weights={"residual": [rng.rand(2048, 1)],
-                          "BCs": [100.0 * rng.rand(64, 1), None]},
-            dist=dist)
+        if per_point:
+            solver.compile(
+                [2, 16, 16, 1], f_model, domain, bcs, Adaptive_type=1,
+                dict_adaptive={"residual": [True], "BCs": [True, False]},
+                init_weights={"residual": [rng.rand(2048, 1)],
+                              "BCs": [100.0 * rng.rand(64, 1), None]},
+                dist=dist)
+        else:
+            # resampling is incompatible with per-point residual lambda
+            solver.compile([2, 16, 16, 1], f_model, domain, bcs, dist=dist)
         return solver
 """)
 
@@ -101,7 +117,7 @@ def worker_dir(tmp_path_factory):
     return d
 
 
-def _run_cluster(worker_dir, nproc=2, timeout=420):
+def _run_cluster(worker_dir, nproc=2, timeout=420, mode="sa"):
     port = _free_port()
     env = dict(os.environ,
                PALLAS_AXON_POOL_IPS="",  # never dial the TPU relay
@@ -109,7 +125,7 @@ def _run_cluster(worker_dir, nproc=2, timeout=420):
     env.pop("JAX_PLATFORMS", None)   # worker pins cpu itself
     procs = [subprocess.Popen(
         [sys.executable, str(worker_dir / "worker.py"),
-         str(i), str(nproc), str(port)],
+         str(i), str(nproc), str(port), mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         cwd=worker_dir, env=env) for i in range(nproc)]
     try:
@@ -148,4 +164,31 @@ def test_two_process_cluster_full_solver(worker_dir, eight_devices):
     assert mh_losses.shape == sp_losses.shape
     np.testing.assert_allclose(mh_losses, sp_losses, rtol=1e-4,
                                err_msg="multi-process loss trajectory "
+                               "diverged from single-process")
+
+
+@pytest.mark.slow
+def test_two_process_resampling_matches_single_process(worker_dir,
+                                                       eight_devices):
+    """Adaptive resampling across a 2-process mesh: the pool draw and the
+    seeded selection are process-identical and the scores ride
+    process_allgather, so the redrawn point set — and therefore the whole
+    loss trajectory — must match the single-process dist run exactly."""
+    out = _run_cluster(worker_dir, mode="resample", timeout=900)
+    line = [ln for ln in out.splitlines() if ln.startswith("LOSSES")]
+    assert line, f"worker 0 printed no losses:\n{out[-2000:]}"
+    mh_losses = np.array([float(v) for v in line[0].split()[1:]])
+
+    sys.path.insert(0, str(worker_dir))
+    try:
+        import mh_problem
+        solver = mh_problem.build_solver(dist=True, per_point=False)
+    finally:
+        sys.path.pop(0)
+    solver.fit(tf_iter=20, newton_iter=0, chunk=5, resample_every=10)
+    sp_losses = np.array([d["Total Loss"] for d in solver.losses])
+
+    assert mh_losses.shape == sp_losses.shape
+    np.testing.assert_allclose(mh_losses, sp_losses, rtol=1e-4,
+                               err_msg="multi-process resampled trajectory "
                                "diverged from single-process")

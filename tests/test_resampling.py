@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, dirichletBC, grad
-from tensordiffeq_tpu.ops.resampling import (importance_select,
+from tensordiffeq_tpu.ops.resampling import (_scores_multihost,
+                                             importance_select,
                                              make_residual_resampler,
                                              residual_scores)
 
@@ -45,6 +46,27 @@ def test_importance_select_survives_extreme_scores():
                             rng=rng)
     hot = (idx < 1_000).mean()
     assert hot > 0.4  # still concentrated, not the uniform fallback's ~10%
+
+
+def test_multihost_scoring_matches_gather_path(eight_devices):
+    """_scores_multihost (per-shard scores + allgather assembly) must be
+    bitwise-identical to the plain gather path — the multi-host resampled
+    trajectory reproduces the single-host one only if the two reductions
+    never drift (they share _row_scores; this guards the assembly)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+    def residual_fn(params, X):  # two "equations", row-dependent magnitudes
+        return (X[:, :1] * 3.0, jnp.stack([X[:, 1], -2.0 * X[:, 1]], 1))
+
+    X_np = np.random.default_rng(0).normal(size=(64, 2)).astype(np.float32)
+    X_sharded = jax.device_put(jnp.asarray(X_np), sharding)
+    ref = residual_scores(residual_fn, None, jnp.asarray(X_np))
+    got = _scores_multihost(residual_fn, None, X_sharded, 64)
+    np.testing.assert_array_equal(got, ref)
 
 
 def test_resampler_mesh_divisibility_validated_up_front(eight_devices):

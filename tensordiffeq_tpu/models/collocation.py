@@ -213,6 +213,11 @@ class CollocationSolverND:
             self.lambdas = {"residual": [], "BCs": []}
 
         self.X_f = jnp.asarray(domain.X_f, jnp.float32)
+        # host copy of the current collocation set; the resample hook keeps
+        # it fresh.  Host-side consumers (NTK live subsample) read this —
+        # the device array can span non-addressable devices on a
+        # multi-process mesh, where np.asarray(self.X_f) is illegal.
+        self._X_f_host = np.asarray(domain.X_f, np.float32)
         if self.use_ntk:
             # one scalar weight per loss term, starting balanced at 1;
             # refreshed from NTK traces between training chunks
@@ -628,6 +633,10 @@ class CollocationSolverND:
             # per-point λ stay row-consistent across fit()/update_loss() calls
             self.X_f, self.lambdas = shard_data_inputs(self.X_f, self.lambdas,
                                                        mesh=mesh)
+            host = getattr(self, "_X_f_host", None)
+            if host is not None and host.shape[0] != int(self.X_f.shape[0]):
+                # shard_data_inputs trims to a device multiple (prefix slice)
+                self._X_f_host = host[: int(self.X_f.shape[0])]
         X_f = self.X_f
         lambdas = self.lambdas
 
@@ -656,6 +665,9 @@ class CollocationSolverND:
                 X_new = base_resampler(params, epoch + epoch_offset)
                 # later phases (L-BFGS) and fit() calls use the final redraw
                 self.X_f = X_new
+                host = getattr(base_resampler, "last_host", None)
+                if host is not None:
+                    self._X_f_host = host
                 return X_new
 
         result = FitResult()
@@ -675,22 +687,18 @@ class CollocationSolverND:
                 # only when resampling: thread the LIVE collocation subsample
                 # into the residual traces so the balance follows each
                 # redraw.  The plain path keeps the compile-time points baked
-                # inside jit.  residual_subsample's eager gather reads the
-                # whole X_f on the host, which a cross-host array forbids —
-                # NTK + resampling together stay single-process for now
-                # (resampling alone is multi-host-safe, ops/resampling.py).
-                import jax as _jax
-                if _jax.process_count() > 1:
-                    raise NotImplementedError(
-                        "Adaptive_type=3 (NTK) combined with resample_every "
-                        "is not supported on a multi-process mesh: the NTK "
-                        "rebalance subsamples the live collocation set on "
-                        "the host, which cannot read a cross-host array. "
-                        "Drop one of the two, or run single-process.")
+                # inside jit.  residual_subsample reads the point set on the
+                # host, which a cross-host device array forbids — so it reads
+                # the maintained host copy (_X_f_host, refreshed by the
+                # resample hook; identical on every process because the pool
+                # draw and selection are seed-deterministic).
                 from ..ops.ntk import residual_subsample
 
                 def ntk_update(p):
-                    return self._ntk_fn(p, residual_subsample(self.X_f))
+                    src = getattr(self, "_X_f_host", None)
+                    if src is None:  # pre-refactor pickles: device array
+                        src = self.X_f
+                    return self._ntk_fn(p, residual_subsample(src))
             trainables, self.opt_state, result = fit_adam(
                 self.loss_fn, self.params, lambdas, X_f,
                 tf_iter=tf_iter, batch_sz=batch_sz, lr=self.lr,

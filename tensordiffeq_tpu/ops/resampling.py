@@ -179,6 +179,11 @@ def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,
         rng = np.random.default_rng(sel_ss)
         idx = importance_select(scores, n_f, temp=temp,
                                 uniform_frac=uniform_frac, rng=rng)
-        return _place(pool[np.sort(idx)])
+        X_np = np.asarray(pool[np.sort(idx)], np.float32)
+        # host copy for callers that must read the live set without touching
+        # the device array (NTK subsample on multi-process meshes) —
+        # identical on every process by seed determinism
+        resample.last_host = X_np
+        return _place(X_np)
 
     return resample

@@ -485,7 +485,11 @@ def scale_payload(out):
 # --------------------------------------------------------------------------- #
 def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
                      adam_iter=10_000, newton_iter=10_000,
-                     eval_every=1_000):
+                     eval_every=1_000, on_eval=None):
+    """``on_eval(snapshot)`` fires at every periodic evaluation so the
+    worker can stream partial payloads — a tunnel death 80 minutes into
+    the full run must still leave the rel-L2 progress on record (the
+    supervisor's salvage path tags the last streamed line "partial")."""
     from tensordiffeq_tpu.exact import allen_cahn_solution
     from tensordiffeq_tpu.helpers import find_L2_error
 
@@ -517,6 +521,9 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
         if t_target is None and l2 <= target:
             t_target = round(t, 1)
         log(f"[full] t={t:7.1f}s {phase}@{step}: rel-L2={l2:.3e}")
+        if on_eval is not None:
+            on_eval({"wall": round(t, 1), "l2": l2, "t_target": t_target,
+                     "timeline": list(timeline)})
 
     solver.fit(tf_iter=adam_iter, newton_iter=newton_iter,
                eval_fn=eval_fn, eval_every=eval_every)
@@ -589,19 +596,32 @@ def worker_main(args):
         if payload is None:
             raise RuntimeError(f"all scale points failed: {out}")
     elif args.full:
+        def full_payload(r):
+            p = {"metric":
+                 "AC-SA wall-clock (10k Adam + 10k L-BFGS) w/ rel-L2",
+                 "value": round(r["wall"], 2), "unit": "s",
+                 "vs_baseline": r["l2"], "rel_l2": r["l2"],
+                 "time_to_l2_2.1e-2": r["t_target"],
+                 "timeline": r["timeline"]}
+            return p
+
+        def on_eval(snap):
+            # stream a salvageable snapshot per evaluation (backend tag
+            # added here because the salvage path never reaches the
+            # setdefault at the bottom of worker_main)
+            import jax
+            p = full_payload(snap)
+            p["backend"] = jax.default_backend()
+            p["device_kind"] = jax.devices()[0].device_kind
+            print(json.dumps(p), flush=True)
+
         res = bench_time_to_l2(
             n_f, nx, nt, widths,
             adam_iter=100 if fast else 10_000,
             newton_iter=100 if fast else 10_000,
-            eval_every=50 if fast else 1_000)
-        payload = {
-            "metric": "AC-SA wall-clock (10k Adam + 10k L-BFGS) w/ rel-L2",
-            "value": round(res["wall"], 2), "unit": "s",
-            "vs_baseline": res["l2"],  # achieved rel-L2 recorded alongside
-            "rel_l2": res["l2"],
-            "time_to_l2_2.1e-2": res["t_target"],
-            "timeline": res["timeline"],
-        }
+            eval_every=50 if fast else 1_000,
+            on_eval=on_eval)
+        payload = full_payload(res)
     else:
         r = bench_jax_throughput(n_f, nx, nt, widths, n_steps)
         base = get_baseline(n_f, nx, widths, max(3, n_steps // 10))

@@ -28,7 +28,8 @@ promote() {
         echo "[$name] backend is not tpu, kept in $new, NOT promoted"
         return 1
     fi
-    if grep -q '"partial"' "$new" && [ -s "BENCH_TPU_$name.json" ]; then
+    if grep -q '"partial"' "$new" && [ -s "BENCH_TPU_$name.json" ] \
+            && ! grep -q '"partial"' "BENCH_TPU_$name.json"; then
         echo "[$name] partial sweep kept in $new; complete artifact retained"
         return 1
     fi

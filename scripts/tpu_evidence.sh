@@ -1,8 +1,13 @@
 #!/bin/bash
-# Round-2 TPU evidence queue: run the full measurement suite the moment the
-# TPU tunnel is healthy.  Each step is independent AND idempotent — a step
+# TPU evidence queue: run the full measurement suite the moment the TPU
+# tunnel is healthy.  Each step is independent AND idempotent — a step
 # whose canonical artifact already exists is skipped, so the watcher can
 # re-pass after a mid-suite tunnel death and only fill the gaps.
+#
+# ORDER = evidence-per-minute under a flaky tunnel (round-2 lesson: the
+# tunnel surfaces rarely and briefly): the four short captures (~45 min
+# total) run before the 90-minute AC-SA convergence, which additionally
+# streams per-eval snapshots so even a truncated run salvages a partial.
 #
 # Results are written to runs/<name>.new first and only promoted to the
 # canonical BENCH_TPU_<name>.json when they are real TPU measurements
@@ -16,39 +21,39 @@ mkdir -p runs
 echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
-echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
-# BENCH_BUDGET sits inside the outer timeout so bench.py always gets to
-# print its JSON line (and salvage partials) before the external kill
-if have_complete full; then echo "already captured"; else
-    BENCH_BUDGET=5300 BENCH_TIMEOUT=5100 timeout 5500 python bench.py --full \
-        > runs/full.new 2> runs/ac_sa_full_tpu.log
-    promote full
-fi
-
-echo "=== 2. headline throughput (autotune now includes pallas) ==="
+echo "=== 1. headline throughput (autotune now includes pallas) ==="
 # always re-run: the tracked artifact predates the pallas autotune fix, and
 # promote() only replaces it with a real TPU measurement
 timeout 1800 python bench.py > runs/default.new 2> runs/bench_default_tpu.log
 promote default
 
-echo "=== 3. precision axis (incl bf16-taylor) ==="
+echo "=== 2. engines ==="
+# always re-run (old artifact lacks the backend field); promote-gated
+BENCH_BUDGET=1700 timeout 1800 python bench.py --engines \
+    > runs/engines.new 2> runs/bench_engines_tpu.log
+promote engines
+
+echo "=== 3. precision axis (incl bf16-taylor + bf16-pallas) ==="
 if have_complete precision; then echo "already captured"; else
     BENCH_BUDGET=2300 timeout 2500 python bench.py --precision \
         > runs/precision.new 2> runs/bench_precision_tpu.log
     promote precision
 fi
 
-echo "=== 4. engines ==="
-# always re-run (old artifact lacks the backend field); promote-gated
-BENCH_BUDGET=1700 timeout 1800 python bench.py --engines \
-    > runs/engines.new 2> runs/bench_engines_tpu.log
-promote engines
-
-echo "=== 5. on-hardware kernel parity tests ==="
+echo "=== 4. on-hardware kernel parity tests ==="
 if [ -s runs/hwtests_tpu.log ] && grep -q "passed" runs/hwtests_tpu.log; then
     echo "already captured"
 else
     timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log
+fi
+
+echo "=== 5. AC-SA full convergence (10k Adam + 10k L-BFGS) ==="
+# BENCH_BUDGET sits inside the outer timeout so bench.py always gets to
+# print its JSON line (and salvage streamed partials) before the kill
+if have_complete full; then echo "already captured"; else
+    BENCH_BUDGET=5300 BENCH_TIMEOUT=5100 timeout 5500 python bench.py --full \
+        > runs/full.new 2> runs/ac_sa_full_tpu.log
+    promote full
 fi
 
 echo "ALL TPU EVIDENCE CAPTURED"

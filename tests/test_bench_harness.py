@@ -129,6 +129,11 @@ def test_promote_partial_only_fills_gaps(tmp_path):
     # ... but is better than nothing
     rc, final = _promote(tmp_path, "w", partial)
     assert rc == 0 and json.loads(final)["value"] == 3
+    # ... and a richer later partial replaces an earlier partial (a flaky
+    # tunnel's best salvage must not be discarded)
+    richer = '{"value": 4, "backend": "tpu", "partial": "timed out later"}'
+    rc, final = _promote(tmp_path, "w", richer, preexisting=partial)
+    assert rc == 0 and json.loads(final)["value"] == 4
 
 
 def test_have_complete_rechecks_partials(tmp_path):

@@ -29,15 +29,23 @@ sys.path.insert(0, ROOT)
 from tensordiffeq_tpu import DiscoveryModel, grad
 from tensordiffeq_tpu.exact import allen_cahn_solution
 
-TOTAL = int(os.environ.get("DISC_ITERS", 20_000))
-LEG = 5_000
+TOTAL = int(os.environ.get("DISC_ITERS", 12_000))
+LEG = 3_000
 CKPT = os.path.join(ROOT, "runs", "discovery_converge_ckpt")
 OUT = os.path.join(ROOT, "runs", "cpu_discovery_converge.json")
 
 
 def main():
     x, t, usol = allen_cahn_solution()
-    x, t, usol = x[::4], t[::4], usol[::4, ::4]
+    # FULL x-resolution, subsampled time: the first attempt subsampled BOTH
+    # axes [::4] and converged to a biased solution (c2 peak 4.73 then
+    # drift, c1 inflating steadily — runs/cpu_discovery_128x51_biased.json):
+    # dx=0.0157 cannot resolve the AC interface width ~sqrt(c1_true)=0.01,
+    # so the smoothed interfaces demand a larger effective diffusion.  The
+    # 512-point x-grid (dx=0.0039, the reference's resolution) keeps the
+    # interfaces; t[::8] (26 slices) is benign — AC dynamics are smooth in
+    # t — and keeps the row count CPU-feasible.
+    x, t, usol = x, t[::8], usol[:, ::8]
     X = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
     u_star = usol.reshape(-1, 1)
 
@@ -52,7 +60,7 @@ def main():
     model.compile([2, 64, 64, 64, 64, 1], f_model,
                   [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
                   col_weights=rng.rand(X.shape[0], 1), varnames=["x", "t"],
-                  lr_vars=0.02, verbose=False)
+                  lr_vars=0.01, verbose=False)
 
     done = 0
     if os.path.isdir(CKPT):
@@ -74,7 +82,7 @@ def main():
     c1, c2 = (float(v) for v in model.vars)
     traj = model.var_history[::10]
     out = {"grid": f"{len(x)}x{len(t)}", "net": "2-64x4-1",
-           "adam": done, "lr_vars": 0.02,
+           "adam": done, "lr_vars": 0.01,
            "c1": c1, "c1_true": 0.0001, "c1_abs_err": abs(c1 - 0.0001),
            "c2": c2, "c2_true": 5.0,
            "c2_rel_err": abs(c2 - 5.0) / 5.0,

@@ -25,7 +25,12 @@ from tensordiffeq_tpu.exact import allen_cahn_solution
 
 
 def main():
-    args = example_args("Allen-Cahn coefficient discovery", flags=("no-sa",))
+    args = example_args(
+        "Allen-Cahn coefficient discovery", flags=("no-sa",),
+        iters=(0, "override total Adam iters (0 = config default)"),
+        lr_vars=(0.0, "coefficient learning rate (0 = library default; "
+                      "0.01 converges fastest on the full grid)"),
+        out=("", "write a JSON summary + coefficient trajectory here"))
     use_sa = not args.no_sa
 
     x, t, usol = allen_cahn_solution()
@@ -44,14 +49,17 @@ def main():
     col_weights = rng.rand(X.shape[0], 1) if use_sa else None
     widths = [128] * 4 if not args.quick else [32] * 2
 
+    lr_vars_kw = {"lr_vars": args.lr_vars} if args.lr_vars else {}
+
     def build():
         model = DiscoveryModel()
         model.compile([2, *widths, 1], f_model,
                       [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
-                      col_weights=col_weights, varnames=["x", "t"])
+                      col_weights=col_weights, varnames=["x", "t"],
+                      **lr_vars_kw)
         return model
 
-    total = scaled(args, 10_000, 300)
+    total = args.iters or scaled(args, 10_000, 300)
     leg = total // 2
 
     model = build()
@@ -66,8 +74,18 @@ def main():
     model.restore_checkpoint(ckpt)
     model.fit(tf_iter=total - leg)
 
-    c1, c2 = model.vars
-    print(f"c1 = {float(c1):.6f} (true 0.0001), c2 = {float(c2):.4f} (true 5.0)")
+    c1, c2 = (float(v) for v in model.vars)
+    print(f"c1 = {c1:.6f} (true 0.0001), c2 = {c2:.4f} (true 5.0)")
+    if args.out:
+        import json
+        summary = {"grid": f"{len(x)}x{len(t)}", "net": f"2-{widths[0]}x{len(widths)}-1",
+                   "adam": total, "lr_vars": args.lr_vars or None, "sa": use_sa,
+                   "c1": c1, "c1_true": 0.0001, "c1_abs_err": abs(c1 - 0.0001),
+                   "c2": c2, "c2_true": 5.0, "c2_rel_err": abs(c2 - 5.0) / 5.0,
+                   "final_loss": float(model.losses[-1]),
+                   "trajectory_every10": model.var_history[::10]}
+        with open(args.out, "w") as fh:
+            json.dump(summary, fh)
     return model
 
 

@@ -41,10 +41,15 @@ elif healthy; then
     grep -a "Error u" runs/burgers_full_tpu.log || tail -3 runs/burgers_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
-echo "=== C. Allen-Cahn discovery (512x201 grid, SA, 10k Adam, ckpt+resume) ==="
-if done_marker runs/ac_discovery_full_tpu.log "c1 = "; then echo "done already"
+echo "=== C. Allen-Cahn discovery (512x201 grid, SA, 20k Adam, ckpt+resume) ==="
+# 20k iters at lr_vars=0.01: the round-2 CPU trajectory analysis showed the
+# default 0.005/10k budget leaves c2 still climbing; TPU iters are cheap.
+if done_marker runs/ac_discovery_full_tpu.log "c1 = " \
+        && [ -s runs/ac_discovery_full_tpu.json ]; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/ac_discovery.py > runs/ac_discovery_full_tpu.log 2>&1
+    timeout 5400 python examples/ac_discovery.py \
+        --iters 20000 --lr_vars 0.01 --out runs/ac_discovery_full_tpu.json \
+        > runs/ac_discovery_full_tpu.log 2>&1
     grep -a "c1 = " runs/ac_discovery_full_tpu.log || tail -3 runs/ac_discovery_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 

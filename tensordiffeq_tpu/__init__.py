@@ -37,7 +37,8 @@ from .boundaries import (  # noqa: F401
 from .domains import DomainND  # noqa: F401
 from .helpers import find_L2_error  # noqa: F401
 from .models import CollocationSolverND, DiscoveryModel  # noqa: F401
-from .networks import MLP, neural_net  # noqa: F401
+from .networks import (MLP, FourierMLP, PeriodicMLP, fourier_net,  # noqa: F401
+                       neural_net, periodic_net)
 from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
 

@@ -834,11 +834,22 @@ class CollocationSolverND:
 
     def _arch_meta(self) -> dict:
         act = getattr(self.net, "activation", None)
-        return {"format": 1,
+        meta = {"format": 1,
                 "layer_sizes": list(self.layer_sizes),
                 "activation": getattr(act, "__name__", str(act)),
                 "network_type": type(self.net).__name__,
                 "n_out": self.n_out}
+        # embedding-net hyperparameters, so load_model can rebuild them
+        from ..networks import FourierMLP, PeriodicMLP
+        if type(self.net) is FourierMLP:
+            meta["net_config"] = {"n_frequencies": self.net.n_frequencies,
+                                  "sigma": self.net.sigma,
+                                  "feature_seed": self.net.feature_seed}
+        elif type(self.net) is PeriodicMLP:
+            meta["net_config"] = {"periodic": [list(s) for s in
+                                               self.net.periodic],
+                                  "n_harmonics": self.net.n_harmonics}
+        return meta
 
     def save(self, path: str):
         """Serialise the network — *self-describing*, like the reference's
@@ -876,11 +887,22 @@ class CollocationSolverND:
             meta, blob = None, raw
 
         if self._compiled:
-            if meta is not None and (list(meta["layer_sizes"])
-                                     != list(self.layer_sizes)):
-                raise ValueError(
-                    f"saved model has layer_sizes {meta['layer_sizes']} but "
-                    f"this solver was compiled with {self.layer_sizes}")
+            if meta is not None:
+                if list(meta["layer_sizes"]) != list(self.layer_sizes):
+                    raise ValueError(
+                        f"saved model has layer_sizes {meta['layer_sizes']} "
+                        f"but this solver was compiled with "
+                        f"{self.layer_sizes}")
+                # embedding nets compute a fixed function of their config
+                # (Fourier B matrix, harmonic spec): a silent mismatch would
+                # load weights into a *different* function, so compare the
+                # full architecture record, not just the Dense shapes
+                mine = self._arch_meta()
+                for k in ("network_type", "net_config"):
+                    if meta.get(k, mine.get(k)) != mine.get(k):
+                        raise ValueError(
+                            f"saved model {k} {meta.get(k)!r} does not "
+                            f"match the compiled network's {mine.get(k)!r}")
             self.params = flax.serialization.from_bytes(self.params, blob)
             return self
 
@@ -889,16 +911,30 @@ class CollocationSolverND:
                 "this file has no architecture metadata (saved by an older "
                 "version); compile(...) the solver with the matching "
                 "layer_sizes first, then load_model")
-        if meta.get("network_type") != "MLP" \
+        ntype = meta.get("network_type")
+        rebuildable = ("MLP", "FourierMLP", "PeriodicMLP")
+        if ntype not in rebuildable \
                 or "tanh" not in str(meta.get("activation", "")):
             raise ValueError(
-                f"only the standard tanh MLP can be reconstructed from "
-                f"metadata (file has {meta.get('network_type')}/"
+                f"only tanh networks of type {rebuildable} can be "
+                f"reconstructed from metadata (file has {ntype}/"
                 f"{meta.get('activation')}); build the custom network "
                 "yourself and compile(..., network=...) before load_model")
         self.layer_sizes = list(meta["layer_sizes"])
         self.n_out = int(meta.get("n_out", self.layer_sizes[-1]))
-        self.net = neural_net(self.layer_sizes)
+        if ntype == "FourierMLP":
+            from ..networks import FourierMLP
+            self.net = FourierMLP(layer_sizes=tuple(self.layer_sizes),
+                                  **meta["net_config"])
+        elif ntype == "PeriodicMLP":
+            from ..networks import PeriodicMLP
+            cfg = meta["net_config"]
+            self.net = PeriodicMLP(
+                layer_sizes=tuple(self.layer_sizes),
+                periodic=tuple(tuple(s) for s in cfg["periodic"]),
+                n_harmonics=cfg["n_harmonics"])
+        else:
+            self.net = neural_net(self.layer_sizes)
         template = self.net.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, self.layer_sizes[0]), jnp.float32))

@@ -25,6 +25,13 @@ class MLP(nn.Module):
 
     Matches the reference network family: Dense(tanh, glorot_normal) hidden
     layers, linear glorot-normal output (``networks.py:12-19``).
+
+    Subclasses override :meth:`_embed` to transform the raw coordinates
+    before the dense stack (Fourier features, periodic harmonics, …); the
+    stack itself — init, precision, dtype plumbing — lives here once.
+    NOTE: the fused Taylor engine gates on ``type(net) is MLP``
+    (``ops/fused.py::mlp_qualifies``), so embedding subclasses correctly
+    fall back to the generic residual engine.
     """
 
     layer_sizes: Sequence[int]
@@ -33,8 +40,12 @@ class MLP(nn.Module):
     param_dtype: Any = jnp.float32
     dtype: Any = jnp.float32
 
+    def _embed(self, x):
+        return x
+
     @nn.compact
     def __call__(self, x):
+        x = self._embed(x)
         kernel_init = nn.initializers.glorot_normal()
         for width in self.layer_sizes[1:-1]:
             x = nn.Dense(width, kernel_init=kernel_init,
@@ -53,6 +64,101 @@ def neural_net(layer_sizes: Sequence[int], activation: Callable = nn.tanh,
     """Build the standard PINN MLP (parity: reference ``networks.py:10``)."""
     return MLP(layer_sizes=tuple(layer_sizes), activation=activation,
                precision=precision, dtype=dtype)
+
+
+class FourierMLP(MLP):
+    """Random-Fourier-feature MLP — beyond-reference network family.
+
+    Embeds coordinates as ``[cos(2π·xB), sin(2π·xB)]`` with a fixed Gaussian
+    frequency matrix ``B ~ N(0, σ²)`` before the tanh stack (Tancik et al.
+    2020; the standard spectral-bias fix for PINNs, Wang/Wang/Perdikaris
+    2021).  ``layer_sizes`` keeps the solver convention ``[n_coords, h…,
+    n_out]`` — the embedding widens the first Dense input internally, so
+    this drops into ``compile(..., network=FourierMLP([...]))`` unchanged.
+
+    ``B`` is a deterministic constant (seeded, not trained): under jit it
+    folds into the first matmul's operand, so the only cost over a plain
+    MLP is one extra (N, n_in)x(n_in, m) matmul + sin/cos on the VPU.
+    """
+
+    n_frequencies: int = 64
+    sigma: float = 1.0
+    feature_seed: int = 0
+
+    def _embed(self, x):
+        n_in = self.layer_sizes[0]
+        B = self.sigma * jax.random.normal(
+            jax.random.PRNGKey(self.feature_seed),
+            (n_in, self.n_frequencies), dtype=jnp.float32)
+        z = (2.0 * jnp.pi) * (x @ B)
+        return jnp.concatenate([jnp.cos(z), jnp.sin(z)], axis=-1)
+
+
+class PeriodicMLP(MLP):
+    """MLP with an *exactly periodic* input embedding — beyond-reference.
+
+    Coordinates named in ``periodic`` (``(dim_index, lower_bound, period)``
+    triples, indices in the domain's ``vars`` declaration order — the same
+    column order the solver feeds coordinates) are replaced by ``m``
+    harmonics ``cos(k·θ), sin(k·θ)`` with ``θ = 2π(x−lb)/P``; remaining
+    coordinates pass through unchanged.  The ansatz is then periodic in
+    those coordinates *to every derivative order by construction*, so a
+    ``periodicBC`` (which the reference enforces softly, matching each
+    returned derivative upper-vs-lower edge, ``models.py:143-149``) is
+    satisfied identically — its loss terms can be kept (they sit at ~1e-15)
+    or dropped outright, and the network spends its whole capacity on the
+    interior residual.  On Allen-Cahn this is the natural ansatz: the
+    domain is x-periodic with period 2.
+    """
+
+    periodic: Sequence[tuple] = ()  # (dim_index, lb, period) triples
+    n_harmonics: int = 4
+
+    def _embed(self, x):
+        n_in = self.layer_sizes[0]
+        spec = {int(d): (float(lb), float(p)) for d, lb, p in self.periodic}
+        ks = jnp.arange(1, self.n_harmonics + 1, dtype=jnp.float32)
+        feats = []
+        for j in range(n_in):
+            xj = x[..., j:j + 1]
+            if j in spec:
+                lb, period = spec[j]
+                theta = (2.0 * jnp.pi / period) * (xj - lb)
+                feats += [jnp.cos(theta * ks), jnp.sin(theta * ks)]
+            else:
+                feats.append(xj)
+        return jnp.concatenate(feats, axis=-1)
+
+
+def fourier_net(layer_sizes: Sequence[int], n_frequencies: int = 64,
+                sigma: float = 1.0, seed: int = 0, **kw) -> FourierMLP:
+    """Build a random-Fourier-feature MLP (see :class:`FourierMLP`)."""
+    return FourierMLP(layer_sizes=tuple(layer_sizes),
+                      n_frequencies=n_frequencies, sigma=sigma,
+                      feature_seed=seed, **kw)
+
+
+def periodic_net(layer_sizes: Sequence[int], domain, periodic_vars,
+                 n_harmonics: int = 4, **kw) -> PeriodicMLP:
+    """Build an exactly-periodic MLP from a :class:`~.domains.DomainND`.
+
+    ``periodic_vars`` names the domain variables (e.g. ``["x"]``) to embed
+    periodically; bounds/periods are read off the domain, and dim indices
+    follow the domain's variable order (the same order ``compile`` feeds
+    coordinates to the network).
+    """
+    spec = []
+    for var in periodic_vars:
+        if var not in domain.vars:
+            raise ValueError(
+                f"periodic var {var!r} not in domain vars {domain.vars}")
+        # declaration (self.vars) order — the X_f/predict column order —
+        # NOT domaindict (add-call) order, which may differ
+        j = domain.var_index(var)
+        lo, hi = domain.bounds(var)
+        spec.append((j, lo, hi - lo))
+    return PeriodicMLP(layer_sizes=tuple(layer_sizes),
+                       periodic=tuple(spec), n_harmonics=n_harmonics, **kw)
 
 
 def init_params(model: nn.Module, n_in: int, key: jax.Array):

@@ -46,3 +46,158 @@ def test_multi_output():
     net = neural_net([3, 16, 2])
     params = init_params(net, 3, jax.random.PRNGKey(2))
     assert net.apply(params, jnp.zeros((5, 3))).shape == (5, 2)
+
+
+# ---------------------------------------------------------------------------
+# Beyond-reference network families: Fourier features + periodic embedding
+# ---------------------------------------------------------------------------
+
+def test_fourier_mlp_shapes_and_jit():
+    from tensordiffeq_tpu.networks import fourier_net
+    net = fourier_net([2, 16, 16, 1], n_frequencies=8, sigma=2.0)
+    params = init_params(net, 2, jax.random.PRNGKey(0))
+    y = jax.jit(net.apply)(params, jnp.ones((5, 2)))
+    assert y.shape == (5, 1) and np.isfinite(np.asarray(y)).all()
+    # first Dense consumes the 2*m embedding, not the raw coords
+    kernel = jax.tree_util.tree_leaves(
+        params["params"]["Dense_0"]["kernel"])[0]
+    assert kernel.shape[0] == 16
+
+
+def test_fourier_features_deterministic_across_instances():
+    from tensordiffeq_tpu.networks import fourier_net
+    a = fourier_net([1, 8, 1], n_frequencies=4, seed=3)
+    b = fourier_net([1, 8, 1], n_frequencies=4, seed=3)
+    pa = init_params(a, 1, jax.random.PRNGKey(0))
+    x = jnp.linspace(-1, 1, 9).reshape(-1, 1)
+    assert np.allclose(a.apply(pa, x), b.apply(pa, x))
+
+
+def test_periodic_mlp_exact_periodicity_all_orders():
+    """u, u_x, u_xx identical at the two x-edges by construction."""
+    from tensordiffeq_tpu.networks import PeriodicMLP
+    net = PeriodicMLP(layer_sizes=(2, 16, 16, 1),
+                      periodic=((0, -1.0, 2.0),), n_harmonics=3)
+    params = init_params(net, 2, jax.random.PRNGKey(0))
+
+    def u(x, t):
+        return net.apply(params, jnp.stack([x, t])[None, :])[0, 0]
+
+    ts = jnp.linspace(0.0, 1.0, 5)
+    for order in range(3):
+        f = u
+        for _ in range(order):
+            f = jax.grad(f, argnums=0)
+        lo = jax.vmap(lambda t: f(jnp.float32(-1.0), t))(ts)
+        hi = jax.vmap(lambda t: f(jnp.float32(1.0), t))(ts)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(hi),
+                                   rtol=0, atol=1e-5)
+
+
+def test_periodic_net_builder_reads_domain():
+    from tensordiffeq_tpu import DomainND
+    from tensordiffeq_tpu.networks import periodic_net
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 32)
+    dom.add("t", [0.0, 1.0], 8)
+    net = periodic_net([2, 8, 1], dom, ["x"], n_harmonics=2)
+    assert net.periodic == ((0, -1.0, 2.0),)
+    import pytest
+    with pytest.raises(ValueError, match="not in domain"):
+        periodic_net([2, 8, 1], dom, ["y"])
+
+
+def test_custom_network_falls_back_to_generic_engine():
+    """Embedding nets must bypass the MLP-only fused Taylor engine."""
+    from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
+                                  periodicBC, grad)
+    from tensordiffeq_tpu.networks import periodic_net
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 32)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(128, seed=0)
+    init = IC(dom, [lambda x: np.sin(np.pi * x)], var=[["x"]])
+    per = periodicBC(dom, ["x"], [lambda u, x, t: (u(x, t),)])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - grad(grad(u, "x"), "x")(x, t)
+
+    net = periodic_net([2, 8, 8, 1], dom, ["x"], n_harmonics=2)
+    m = CollocationSolverND()
+    m.compile([2, 8, 8, 1], f_model, dom, [init, per], network=net)
+    assert m._fused_residual is None  # generic engine, not Taylor
+    m.fit(tf_iter=5)
+    assert np.isfinite(m.losses[-1]["Total Loss"])
+    # BC_1 is the periodic condition: ~0 by construction from step one
+    assert abs(float(m.losses[-1]["BC_1"])) < 1e-8
+
+
+def test_embedding_net_save_load_roundtrip(tmp_path):
+    """save() records embedding hyperparameters; load_model on an
+    UNCOMPILED solver rebuilds the exact network (transfer-learn flow)."""
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+    from tensordiffeq_tpu.networks import fourier_net, periodic_net
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 16)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(64, seed=0)
+    init = IC(dom, [lambda x: 0.0 * x], var=[["x"]])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    for make in (lambda: fourier_net([2, 8, 1], n_frequencies=4,
+                                     sigma=3.0, seed=7),
+                 lambda: periodic_net([2, 8, 1], dom, ["x"], n_harmonics=2)):
+        m = CollocationSolverND()
+        m.compile([2, 8, 1], f_model, dom, [init], network=make())
+        path = str(tmp_path / f"{type(m.net).__name__}.tdqm")
+        m.save(path)
+
+        fresh = CollocationSolverND().load_model(path)
+        assert type(fresh.net).__name__ == type(m.net).__name__
+        X = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(fresh.net.apply(fresh.params, X)),
+            np.asarray(m.net.apply(m.params, X)), rtol=0, atol=0)
+
+
+def test_periodic_net_uses_declaration_order_not_add_order():
+    """X_f columns follow DomainND declaration order; periodic_net must
+    index the same way even when add() calls came in a different order."""
+    from tensordiffeq_tpu import DomainND
+    from tensordiffeq_tpu.networks import periodic_net
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("t", [0.0, 1.0], 8)       # added first …
+    dom.add("x", [-1.0, 1.0], 32)     # … but x is column 0
+    net = periodic_net([2, 8, 1], dom, ["x"], n_harmonics=1)
+    assert net.periodic == ((0, -1.0, 2.0),)
+
+
+def test_load_model_rejects_mismatched_embedding_config(tmp_path):
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+    from tensordiffeq_tpu.networks import fourier_net
+    import pytest
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 16)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(64, seed=0)
+    init = IC(dom, [lambda x: 0.0 * x], var=[["x"]])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    m = CollocationSolverND()
+    m.compile([2, 8, 1], f_model, dom, [init],
+              network=fourier_net([2, 8, 1], n_frequencies=4, seed=7))
+    path = str(tmp_path / "f.tdqm")
+    m.save(path)
+
+    other = CollocationSolverND()
+    other.compile([2, 8, 1], f_model, dom, [init],
+                  network=fourier_net([2, 8, 1], n_frequencies=4, seed=9))
+    with pytest.raises(ValueError, match="net_config"):
+        other.load_model(path)

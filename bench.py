@@ -293,6 +293,19 @@ def bench_tf_baseline(n_f, nx, widths, n_steps):
 
 def get_baseline(n_f, nx, widths, n_steps):
     key = f"tf_sa_pts_per_sec_nf{n_f}"
+    # Cache-first: the TF step costs ~5 min of the worker's budget on this
+    # 1-core host, for a number the cache already holds as best-ever (max).
+    # Under a tunnel that stays healthy ~15 min at a stretch, that's the
+    # difference between a promoted TPU capture and a timeout.  Set
+    # BENCH_TF_FRESH=1 to force a re-measurement.
+    if os.environ.get("BENCH_TF_FRESH") != "1" and os.path.exists(CACHE):
+        try:
+            cached = json.load(open(CACHE)).get(key)
+        except (OSError, json.JSONDecodeError):
+            cached = None
+        if cached:
+            log(f"[tf] using cached baseline {cached:,.0f} pts/s ({key})")
+            return cached
     try:
         pts = bench_tf_baseline(n_f, nx, widths, n_steps)
         try:
@@ -637,10 +650,12 @@ def worker_main(args):
             "backend": r["backend"],
         }
     # every mode records what it actually ran on: jax can fall back to CPU
-    # without erroring, and promotion scripts gate on backend == "tpu"
+    # without erroring, and promotion scripts gate on backend == "tpu";
+    # "captured" dates the measurement even when artifact mtimes are reset
     import jax
     payload.setdefault("backend", jax.default_backend())
     payload.setdefault("device_kind", jax.devices()[0].device_kind)
+    payload.setdefault("captured", time.strftime("%Y-%m-%d"))
     print(json.dumps(payload), flush=True)
 
 
@@ -761,12 +776,21 @@ def run_worker(flags, timeout, env=None):
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout, cwd=REPO, env=env)
     except subprocess.TimeoutExpired as e:
+        # surface where the worker was when killed — without this the
+        # difference between "tunnel died mid-run" and "budget too small"
+        # is invisible (round-3 step-1 diagnosis)
+        if e.stderr:
+            tail = e.stderr if isinstance(e.stderr, str) \
+                else e.stderr.decode("utf-8", "replace")
+            sys.stderr.write("[supervisor] worker stderr tail at timeout:\n"
+                             + tail[-2000:] + "\n")
         # salvage streamed partial payloads (e.g. --scale prints one line
         # per completed sweep point) before declaring the attempt dead
         payload = last_json_line(e.stdout)
         if payload is not None:
             payload["partial"] = ("worker timed out after this "
                                   "measurement; later points lost")
+            payload.setdefault("captured", time.strftime("%Y-%m-%d"))
             return payload, None
         return None, "worker timed out (backend init hang or slow compile)"
     sys.stderr.write(proc.stderr[-4000:] if proc.stderr else "")
@@ -777,6 +801,7 @@ def run_worker(flags, timeout, env=None):
         if payload is not None:
             payload["partial"] = (f"worker died (rc={proc.returncode}) "
                                   "after this measurement; later points lost")
+            payload.setdefault("captured", time.strftime("%Y-%m-%d"))
             return payload, None
         tail = (proc.stderr or "").strip().splitlines()[-8:]
         return None, f"worker rc={proc.returncode}: " + " | ".join(tail)
@@ -847,14 +872,27 @@ def main():
     # payload NOW — the scoreboard must never be empty when real numbers
     # exist (VERDICT r2 item 1).  The backend_note tag keeps promotion
     # scripts from mistaking this for a fresh measurement.
+    # Watcher mode (BENCH_NO_CPU_FALLBACK=1): a CPU measurement can never
+    # be promoted to a BENCH_TPU_* artifact, so don't burn 25+ min of a
+    # flaky-tunnel window producing one — emit the cached payload (or the
+    # failure sentinel) immediately and let the watcher re-probe.
+    no_cpu = os.environ.get("BENCH_NO_CPU_FALLBACK") == "1"
+
     cached = load_cached_tpu(mode_flags)
     if cached is not None:
         cached["diag"] = diag
-        if remaining() > 240:
+        if remaining() > 240 and not no_cpu:
             cached["cpu_sanity"] = cpu_sanity(remaining() - 30)
         print(json.dumps(cached))
         return
     diag.append("no cached hardware payload for this mode")
+
+    if no_cpu:
+        print(json.dumps({"metric": mode_name(mode_flags), "value": None,
+                          "unit": None, "vs_baseline": None,
+                          "backend_note": "tpu-unreachable-no-cpu-fallback",
+                          "diag": diag}))
+        return
 
     log("[supervisor] falling back to CPU measurement")
     to = min(attempt_cap, remaining() - 15)

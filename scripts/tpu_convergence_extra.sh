@@ -13,6 +13,10 @@ cd "$(dirname "$0")/.."
 mkdir -p runs
 . scripts/_promote.sh
 
+# see tpu_evidence.sh: never burn the tunnel window on unpromotable
+# CPU fallbacks from the watcher
+export BENCH_NO_CPU_FALLBACK=1
+
 healthy() {
     # resolve_backend cache lives in tempfile.gettempdir() (honours TMPDIR,
     # examples/_common.py) — clear it so a stale cpu pin can't survive
@@ -58,8 +62,8 @@ echo "=== D. single-chip N_f scaling sweep (50k..500k) ==="
 # re-attempted once the tunnel recovers (advisor finding, round 2)
 if have_complete scale; then echo "done already"
 elif healthy; then
-    # internal budget 1500s/attempt: TPU attempt + CPU fallback both fit
-    # inside the outer guard with headroom for compiles
+    # 1500s/attempt caps the live TPU sweep; the 4600s budget leaves room
+    # for probe + salvage (CPU fallback is disabled in watcher mode above)
     BENCH_BUDGET=4600 BENCH_TIMEOUT=1500 timeout 4800 python bench.py --scale \
         > runs/scale.new 2> runs/bench_scale_tpu.log
     promote scale

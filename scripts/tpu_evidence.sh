@@ -18,13 +18,21 @@ cd "$(dirname "$0")/.."
 mkdir -p runs
 . scripts/_promote.sh
 
+# CPU fallbacks can't be promoted — never burn tunnel-window minutes on
+# them from the watcher (round-3 lesson: a dead tunnel turned each step
+# into a 25-90 min CPU measurement that promote() then rejected)
+export BENCH_NO_CPU_FALLBACK=1
+
 echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
 echo "=== 1. headline throughput (autotune now includes pallas) ==="
 # always re-run: the tracked artifact predates the pallas autotune fix, and
-# promote() only replaces it with a real TPU measurement
-timeout 1800 python bench.py > runs/default.new 2> runs/bench_default_tpu.log
+# promote() only replaces it with a real TPU measurement.  The watcher run
+# gets a bigger budget than the driver default (1140s): pallas-inclusive
+# autotune plus the AOT compile is ~8-12 min of compiles through the tunnel.
+BENCH_BUDGET=1700 timeout 1800 python bench.py \
+    > runs/default.new 2> runs/bench_default_tpu.log
 promote default
 
 echo "=== 2. engines ==="

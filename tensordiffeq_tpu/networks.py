@@ -152,6 +152,10 @@ def periodic_net(layer_sizes: Sequence[int], domain, periodic_vars,
         if var not in domain.vars:
             raise ValueError(
                 f"periodic var {var!r} not in domain vars {domain.vars}")
+        if var not in domain.domain_ids:
+            raise ValueError(
+                f"periodic var {var!r} declared but never add()ed to the "
+                "domain; call domain.add(...) before periodic_net")
         # declaration (self.vars) order — the X_f/predict column order —
         # NOT domaindict (add-call) order, which may differ
         j = domain.var_index(var)

@@ -83,6 +83,18 @@ elif healthy; then
     grep -a "Error u" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
+echo "=== H. AC-SA with the exactly-periodic embedding net (beyond-reference) ==="
+# same flagship config as ac_sa.py, ansatz periodic in x by construction;
+# compares against the plain AC-SA run (bench --full / step A) at equal
+# budget.  Uses the generic residual engine (embedding nets bypass the
+# MLP-only fused path) — fine on-chip, hours on CPU, hence TPU-gated.
+if done_marker runs/ac_sa_periodic_tpu.log "Error u"; then echo "done already"
+elif healthy; then
+    timeout 5400 python examples/ac_sa.py --periodic-net \
+        > runs/ac_sa_periodic_tpu.log 2>&1
+    grep -a "Error u" runs/ac_sa_periodic_tpu.log || tail -3 runs/ac_sa_periodic_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
 echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="
 if done_marker runs/resample_ablation_tpu.log "improvement"; then echo "done already"
 elif healthy; then

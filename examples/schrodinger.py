@@ -1,0 +1,83 @@
+"""Nonlinear Schrödinger — the classical 2-output PINN benchmark
+(Raissi et al. 2019 §3.1.1).
+
+``i h_t + 0.5 h_xx + |h|^2 h = 0`` on x in [-5, 5], t in [0, pi/2], with
+``h(x, 0) = 2 sech(x)`` and periodic BCs (value + first derivative) in x.
+The network has TWO outputs — h = u + iv — exercising the coupled-system
+surface the reference supports (tuple residuals + per-output ICs,
+``models.py:189-191``) but ships no example of.  Truth: the split-step
+Fourier spectral solution in ``tensordiffeq_tpu.exact``.
+"""
+
+import numpy as np
+
+from _common import example_args, scaled
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
+                              periodicBC)
+from tensordiffeq_tpu.exact import schrodinger_solution
+
+
+def build_problem(n_f: int, nx: int = 256, nt: int = 201, seed: int = 0):
+    t_final = float(np.pi / 2)
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-5.0, 5.0], nx)
+    domain.add("t", [0.0, t_final], nt)
+    domain.generate_collocation_points(n_f, seed=seed)
+
+    # h(x, 0) = 2 sech(x):  u = 2 sech(x), v = 0
+    ics = IC(domain,
+             [lambda x: 2.0 / np.cosh(x), lambda x: 0.0 * x],
+             var=[["x"], ["x"]])
+
+    def deriv_model(u, x, t):
+        return (u[0](x, t), u[1](x, t),
+                grad(u[0], "x")(x, t), grad(u[1], "x")(x, t))
+
+    per = periodicBC(domain, ["x"], [deriv_model])
+
+    def f_model(u, x, t):
+        uv, vv = u[0](x, t), u[1](x, t)
+        sq = uv ** 2 + vv ** 2
+        f_u = grad(u[0], "t")(x, t) + 0.5 * grad(grad(u[1], "x"), "x")(x, t) \
+            + sq * vv
+        f_v = grad(u[1], "t")(x, t) - 0.5 * grad(grad(u[0], "x"), "x")(x, t) \
+            - sq * uv
+        return f_u, f_v
+
+    return domain, [ics, per], f_model
+
+
+def evaluate(solver, args, name):
+    x, t, h = schrodinger_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    pred, _ = solver.predict(Xg, best_model=True)
+    h_pred = np.sqrt(pred[:, 0] ** 2 + pred[:, 1] ** 2)
+    h_true = np.abs(h).reshape(-1)
+    err = tdq.find_L2_error(h_pred, h_true)
+    print(f"Error u: {err:e}  (rel-L2 of |h|)")
+    if args.plot:
+        tdq.plotting.plot_solution_domain1D(
+            solver, [x, t], ub=[5.0, float(np.pi / 2)], lb=[-5.0, 0.0],
+            Exact_u=np.abs(h), save_path=f"{args.plot}/{name}.png",
+            component="abs")
+    return err
+
+
+def main():
+    args = example_args("Nonlinear Schrödinger 2-output PINN")
+    n_f = scaled(args, 20_000, 2_000)
+    nx, nt = (256, 201) if not args.quick else (64, 21)
+    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt)
+    widths = [100] * 4 if not args.quick else [32] * 2
+
+    solver = CollocationSolverND()
+    solver.compile([2, *widths, 2], f_model, domain, bcs)
+    solver.fit(tf_iter=scaled(args, 10_000, 200),
+               newton_iter=scaled(args, 10_000, 100))
+    return evaluate(solver, args, "schrodinger")
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,13 @@ elif healthy; then
     grep -a "Error u" runs/ac_sa_periodic_tpu.log || tail -3 runs/ac_sa_periodic_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
+echo "=== I. Nonlinear Schrödinger (2-output system, N_f=20k, 10k+10k) ==="
+if done_marker runs/schrodinger_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
+    timeout 5400 python examples/schrodinger.py > runs/schrodinger_full_tpu.log 2>&1
+    grep -a "Error u" runs/schrodinger_full_tpu.log || tail -3 runs/schrodinger_full_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
 echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="
 if done_marker runs/resample_ablation_tpu.log "improvement"; then echo "done already"
 elif healthy; then

@@ -49,13 +49,16 @@ def get_griddata(grid, data, dims):
 
 
 def plot_solution_domain1D(model, domain: Sequence[np.ndarray], ub, lb,
-                           Exact_u=None, u_values=None, save_path: Optional[str] = None):
+                           Exact_u=None, u_values=None,
+                           save_path: Optional[str] = None, component=0):
     """Heatmap of u(x,t) plus three time-slice cuts vs the exact solution
     (reference ``plotting.py:31-127``).
 
     ``domain`` is ``[x_linspace, t_linspace]``; ``model`` must expose
     ``predict(X_star) -> (u, f_u)``; pass ``save_path`` to write a PNG
-    instead of showing the window.
+    instead of showing the window.  For multi-output networks ``component``
+    selects the output column, or ``"abs"`` plots the vector magnitude
+    (e.g. |h| for a complex field split into real/imaginary outputs).
     """
     plt = _plt()
     x, t = domain
@@ -63,7 +66,12 @@ def plot_solution_domain1D(model, domain: Sequence[np.ndarray], ub, lb,
     X_star = np.hstack((X.flatten()[:, None], T.flatten()[:, None]))
     if u_values is None:
         u_values, _ = model.predict(X_star)
-    U_pred = get_griddata(X_star, np.asarray(u_values).flatten(), (X, T))
+    u_values = np.asarray(u_values).reshape(X_star.shape[0], -1)
+    if component == "abs":
+        u_values = np.sqrt((u_values ** 2).sum(axis=1))
+    else:
+        u_values = u_values[:, component]
+    U_pred = get_griddata(X_star, u_values.flatten(), (X, T))
 
     fig = plt.figure(figsize=figsize(1.5, 0.9))
     ax = fig.add_subplot(211)

@@ -55,3 +55,14 @@ def test_ac_dist_sa_example_runs():
     device mesh, with SA weights sharded alongside their points and the
     distributed L-BFGS tail the reference disables."""
     run_example("ac_dist.py", "--sa")
+
+
+def test_schrodinger_example_runs():
+    """NLS: the 2-output (coupled real/imaginary) system end-to-end —
+    tuple residual, per-output ICs, multi-output periodic derivatives."""
+    run_example("schrodinger.py")
+
+
+def test_ac_sa_periodic_net_example_runs():
+    """AC-SA with the exactly-periodic embedding ansatz (--periodic-net)."""
+    run_example("ac_sa.py", "--periodic-net")

@@ -61,21 +61,27 @@ def evaluate(solver, args, name):
         tdq.plotting.plot_solution_domain1D(
             solver, [x, t], ub=[5.0, float(np.pi / 2)], lb=[-5.0, 0.0],
             Exact_u=np.abs(h), save_path=f"{args.plot}/{name}.png",
-            component="abs")
+            component="abs", best_model=True)
     return err
 
 
 def main():
-    args = example_args("Nonlinear Schrödinger 2-output PINN")
-    n_f = scaled(args, 20_000, 2_000)
+    args = example_args(
+        "Nonlinear Schrödinger 2-output PINN",
+        nf=(0, "override N_f (0 = config default)"),
+        adam=(0, "override Adam iters (0 = config default)"),
+        newton=(0, "override L-BFGS iters (0 = config default)"),
+        width=(0, "override hidden width (0 = config default)"))
+    n_f = args.nf or scaled(args, 20_000, 2_000)
     nx, nt = (256, 201) if not args.quick else (64, 21)
     domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt)
-    widths = [100] * 4 if not args.quick else [32] * 2
+    w = args.width or (100 if not args.quick else 32)
+    widths = [w] * (4 if not args.quick else 2)
 
     solver = CollocationSolverND()
     solver.compile([2, *widths, 2], f_model, domain, bcs)
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100))
+    solver.fit(tf_iter=args.adam or scaled(args, 10_000, 200),
+               newton_iter=args.newton or scaled(args, 10_000, 100))
     return evaluate(solver, args, "schrodinger")
 
 

@@ -50,7 +50,8 @@ def get_griddata(grid, data, dims):
 
 def plot_solution_domain1D(model, domain: Sequence[np.ndarray], ub, lb,
                            Exact_u=None, u_values=None,
-                           save_path: Optional[str] = None, component=0):
+                           save_path: Optional[str] = None, component=0,
+                           best_model: bool = False):
     """Heatmap of u(x,t) plus three time-slice cuts vs the exact solution
     (reference ``plotting.py:31-127``).
 
@@ -59,13 +60,16 @@ def plot_solution_domain1D(model, domain: Sequence[np.ndarray], ub, lb,
     instead of showing the window.  For multi-output networks ``component``
     selects the output column, or ``"abs"`` plots the vector magnitude
     (e.g. |h| for a complex field split into real/imaginary outputs).
+    ``best_model=True`` plots the best-checkpoint parameters — matching the
+    error every example reports — instead of the last iterate.
     """
     plt = _plt()
     x, t = domain
     X, T = np.meshgrid(x, t)
     X_star = np.hstack((X.flatten()[:, None], T.flatten()[:, None]))
     if u_values is None:
-        u_values, _ = model.predict(X_star)
+        kw = {"best_model": True} if best_model else {}
+        u_values, _ = model.predict(X_star, **kw)
     u_values = np.asarray(u_values).reshape(X_star.shape[0], -1)
     if component == "abs":
         u_values = np.sqrt((u_values ** 2).sum(axis=1))

@@ -48,7 +48,7 @@ def evaluate(solver, args, name):
     if args.plot:
         tdq.plotting.plot_solution_domain1D(
             solver, [x, t], ub=[1.0, 1.0], lb=[-1.0, 0.0], Exact_u=usol,
-            save_path=f"{args.plot}/{name}.png")
+            save_path=f"{args.plot}/{name}.png", best_model=True)
     return err
 
 

@@ -54,7 +54,7 @@ def main():
     if args.plot:
         tdq.plotting.plot_solution_domain1D(
             solver, [x, t], ub=[1.0, 1.0], lb=[-1.0, 0.0], Exact_u=usol,
-            save_path=f"{args.plot}/burgers.png")
+            save_path=f"{args.plot}/burgers.png", best_model=True)
     return err
 
 

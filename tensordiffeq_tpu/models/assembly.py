@@ -36,7 +36,7 @@ import jax.numpy as jnp
 
 from ..boundaries import BC
 from ..ops.derivatives import UFn, make_ufn, vmap_residual
-from ..ops.losses import MSE, g_MSE
+from ..ops.losses import MSE, causal_residual_loss, g_MSE
 
 
 def _as_tuple(x):
@@ -63,7 +63,11 @@ def build_loss_fn(apply_fn: Callable,
                   g: Optional[Callable] = None,
                   data_X: Optional[jnp.ndarray] = None,
                   data_s: Optional[jnp.ndarray] = None,
-                  residual_fn: Optional[Callable] = None) -> Callable:
+                  residual_fn: Optional[Callable] = None,
+                  causal_eps: Optional[float] = None,
+                  causal_bins: int = 32,
+                  time_index: Optional[int] = None,
+                  time_bounds: Optional[tuple] = None) -> Callable:
     """Assemble ``loss(params, lam_bcs, lam_res, X_batch)``.
 
     Args:
@@ -78,6 +82,12 @@ def build_loss_fn(apply_fn: Callable,
       residual_fn: optional fused batched residual ``(params, X) -> preds``
         (one Taylor wavefront, :mod:`tensordiffeq_tpu.ops.fused`); the
         generic per-point engine is used when ``None``.
+      causal_eps / causal_bins / time_index / time_bounds: temporal
+        causality weighting of the residual terms
+        (:func:`~tensordiffeq_tpu.ops.losses.causal_residual_loss`) —
+        enabled when ``causal_eps`` is set; ``time_index`` is the time
+        column of ``X_batch`` and ``time_bounds`` its range.  Composes
+        with per-point SA λ (applied inside the bin means).
 
     Returns a pure function
     ``loss(params, lam_bcs, lam_res, X_batch, lam_data=None) ->
@@ -154,7 +164,26 @@ def build_loss_fn(apply_fn: Callable,
         for j, f_pred in enumerate(f_preds):
             f_pred = f_pred.reshape(-1, 1)
             lam = lam_res[j] if j < len(lam_res) else None
-            if lam is not None:
+            if causal_eps is not None:
+                # per-point squared errors with λ folded in EXACTLY as the
+                # non-causal path below would (g_MSE applies g(λ) per-point
+                # regardless of weight_outside_sum; type-2 scalar λ scales
+                # the whole term), then causality-weighted bin means
+                outer = None
+                if lam is not None and g is not None:
+                    sq = g(lam) * jnp.square(f_pred)       # g_MSE semantics
+                elif lam is not None and not weight_outside_sum:
+                    sq = jnp.square(lam * f_pred)          # SA type-1
+                else:
+                    sq = jnp.square(f_pred)
+                    outer = lam                            # type-2 scalar
+                loss_r, w_last = causal_residual_loss(
+                    sq, X_batch[:, time_index], time_bounds,
+                    causal_eps, causal_bins)
+                if outer is not None:
+                    loss_r = jnp.reshape(outer, ()) * loss_r
+                components[f"Causal_w_last_{j}"] = w_last
+            elif lam is not None:
                 if g is not None:
                     loss_r = g_MSE(f_pred, 0.0, g(lam))
                 else:

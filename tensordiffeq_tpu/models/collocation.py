@@ -71,7 +71,8 @@ class CollocationSolverND:
                 init_weights: Optional[dict] = None,
                 g: Optional[Callable] = None, dist: bool = False,
                 network=None, lr: float = 0.005, lr_weights: float = 0.005,
-                fused: Optional[bool] = None, fused_dtype=None):
+                fused: Optional[bool] = None, fused_dtype=None,
+                causal_eps: Optional[float] = None, causal_bins: int = 32):
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
@@ -111,10 +112,20 @@ class CollocationSolverND:
             to the Adam phase only: L-BFGS line searches break down on
             bf16 gradient noise, so the Newton refinement phase always
             runs a full-precision engine.
+          causal_eps / causal_bins: temporal-causality weighting of the
+            residual (Wang et al. arXiv:2203.07404, beyond-reference) —
+            residual bin ``b`` along time is weighted
+            ``exp(-causal_eps * cumulative earlier-bin loss)``, so later
+            times train only once earlier times are resolved.  Composes
+            with SA λ; per-epoch ``Causal_w_last_j`` in the loss history
+            reports completeness (→1 when the whole horizon trains).
         """
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
                              "domain.generate_collocation_points(N_f) first")
+        if causal_eps is not None and domain.time_var is None:
+            raise ValueError("causal_eps requires a domain with time_var "
+                             "set (causality is ordered along time)")
         keep_params = False
         if layer_sizes is None:
             # transfer-learn flow: reuse the net+params brought in by
@@ -133,6 +144,12 @@ class CollocationSolverND:
         self.g = g
         self.dist = dist
         self.fused = fused
+        self.causal_eps = causal_eps
+        self.causal_bins = causal_bins
+        self._causal_kw = {} if causal_eps is None else dict(
+            causal_eps=causal_eps, causal_bins=causal_bins,
+            time_index=domain.vars.index(domain.time_var),
+            time_bounds=domain.bounds(domain.time_var))
         if fused_dtype is not None:
             if fused is False:
                 import warnings
@@ -323,7 +340,7 @@ class CollocationSolverND:
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, weight_outside_sum=self.weight_outside_sum,
                 g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=res_fn)
+                residual_fn=res_fn, **self._causal_kw)
 
             def value_grad(params, X):
                 return jax.value_and_grad(
@@ -486,7 +503,7 @@ class CollocationSolverND:
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
             data_X=self.data_X, data_s=self.data_s,
-            residual_fn=self._fused_residual)
+            residual_fn=self._fused_residual, **self._causal_kw)
 
         # L-BFGS refinement loss: line searches break down on bf16 gradient
         # noise (a second-order method amplifies ~5% derivative error into
@@ -502,7 +519,7 @@ class CollocationSolverND:
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, weight_outside_sum=self.weight_outside_sum,
                 g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=f32_res)
+                residual_fn=f32_res, **self._causal_kw)
 
         # jit-cached inference paths (params are traced args, so repeated
         # predict() calls reuse one compiled program)

@@ -52,3 +52,50 @@ def test_relative_l2_matches_helper():
 def test_l2_error_zero_for_exact():
     a = np.linspace(1, 2, 50)
     assert find_L2_error(a, a) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Causal residual weighting (beyond-reference; Wang et al. arXiv:2203.07404)
+# ---------------------------------------------------------------------------
+
+def test_causal_residual_loss_hand_computed():
+    from tensordiffeq_tpu.ops.losses import causal_residual_loss
+    sq = jnp.array([1.0, 1.0, 4.0, 4.0])
+    t = jnp.array([0.1, 0.2, 0.7, 0.8])
+    eps = 0.5
+    loss, w_last = causal_residual_loss(sq, t, (0.0, 1.0), eps, 2)
+    # bins: [1,1] -> mean 1 ; [4,4] -> mean 4 ; cum = [0, 1]
+    # w = [1, exp(-0.5)] ; loss = (1*1 + exp(-0.5)*4) / 2
+    expect = (1.0 + np.exp(-0.5) * 4.0) / 2.0
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-6)
+    np.testing.assert_allclose(float(w_last), np.exp(-0.5), rtol=1e-6)
+
+
+def test_causal_eps_zero_is_unweighted_bin_mean():
+    from tensordiffeq_tpu.ops.losses import causal_residual_loss
+    rng = np.random.RandomState(0)
+    sq = jnp.asarray(rng.rand(64))
+    t = jnp.asarray(rng.rand(64))
+    loss, w_last = causal_residual_loss(sq, t, (0.0, 1.0), 0.0, 8)
+    bins = np.clip((np.asarray(t) * 8).astype(int), 0, 7)
+    per_bin = [np.asarray(sq)[bins == b].mean() for b in range(8)]
+    np.testing.assert_allclose(float(loss), np.mean(per_bin), rtol=1e-5)
+    assert float(w_last) == 1.0
+
+
+def test_causal_weights_suppress_late_time():
+    """High residual at early times must gate the late-time contribution."""
+    from tensordiffeq_tpu.ops.losses import causal_residual_loss
+    sq_early_bad = jnp.array([100.0, 100.0, 1.0, 1.0])
+    t = jnp.array([0.05, 0.1, 0.9, 0.95])
+    loss, w_last = causal_residual_loss(sq_early_bad, t, (0.0, 1.0), 1.0, 2)
+    assert float(w_last) < 1e-40  # exp(-100): late bin essentially off
+    np.testing.assert_allclose(float(loss), 100.0 / 2.0, rtol=1e-4)
+
+
+def test_causal_empty_bins_are_harmless():
+    from tensordiffeq_tpu.ops.losses import causal_residual_loss
+    sq = jnp.array([1.0, 1.0])
+    t = jnp.array([0.01, 0.99])  # middle bins empty at n_bins=8
+    loss, w_last = causal_residual_loss(sq, t, (0.0, 1.0), 1.0, 8)
+    assert np.isfinite(float(loss)) and 0 < float(w_last) <= 1.0

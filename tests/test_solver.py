@@ -292,3 +292,73 @@ def test_eager_newton_matches_reference_fixed_step_mode():
     l0, _ = s.update_loss()
     s.fit(tf_iter=0, newton_iter=40, newton_eager=True)
     assert s.min_loss["l-bfgs"] < float(l0)
+
+
+def test_causal_weighting_trains_and_reports_w_last():
+    """compile(causal_eps=...) — causality-gated residual (beyond-reference):
+    w_last is tracked per epoch, composes with SA per-point lambda, and a
+    steady-state domain is rejected with a typed error."""
+    import pytest
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 32)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(256, seed=0)
+    init = IC(dom, [lambda x: np.sin(np.pi * x)], var=[["x"]])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
+
+    rng = np.random.RandomState(0)
+    m = CollocationSolverND(verbose=False)
+    m.compile([2, 16, 16, 1], f_model, dom, [init], Adaptive_type=1,
+              dict_adaptive={"residual": [True], "BCs": [False]},
+              init_weights={"residual": [rng.rand(256, 1)], "BCs": [None]},
+              causal_eps=1.0, causal_bins=8)
+    m.fit(tf_iter=20)
+    w = float(m.losses[-1]["Causal_w_last_0"])
+    assert 0.0 < w <= 1.0
+    assert np.isfinite(float(m.losses[-1]["Total Loss"]))
+
+    steady = DomainND(["x", "y"])
+    steady.add("x", [0.0, 1.0], 8)
+    steady.add("y", [0.0, 1.0], 8)
+    steady.generate_collocation_points(64, seed=0)
+    with pytest.raises(ValueError, match="time_var"):
+        CollocationSolverND(verbose=False).compile(
+            [2, 8, 1], f_model, steady, [], causal_eps=1.0)
+
+
+def test_causal_type2_with_g_matches_noncausal_semantics():
+    """With one causal bin the bin-mean equals the global mean, so the
+    causal residual term must reproduce g_MSE's per-point g(lambda)
+    weighting exactly (regression: the causal path once applied raw lambda
+    outside instead of g(lambda) inside for Adaptive_type=2)."""
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+    from tensordiffeq_tpu.ops.losses import default_g
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 16)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(128, seed=0)
+    init = IC(dom, [lambda x: 0.0 * x], var=[["x"]])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - u(x, t)
+
+    def build(causal):
+        rng = np.random.RandomState(0)
+        m = CollocationSolverND(verbose=False)
+        kw = dict(causal_eps=1.0, causal_bins=1) if causal else {}
+        m.compile([2, 8, 1], f_model, dom, [init], Adaptive_type=2,
+                  dict_adaptive={"residual": [True], "BCs": [False]},
+                  init_weights={"residual": [np.full((1, 1), 0.7)],
+                                "BCs": [None]},
+                  g=default_g, **kw)
+        return m
+
+    a, b = build(False), build(True)
+    la, _ = a.loss_fn(a.params, a.lambdas["BCs"], a.lambdas["residual"], a.X_f)
+    lb, _ = b.loss_fn(b.params, b.lambdas["BCs"], b.lambdas["residual"], b.X_f)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)

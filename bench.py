@@ -132,7 +132,7 @@ def engine_hint(default="autotune"):
     if jax.default_backend() != "tpu":
         return default
     try:
-        with open(os.path.join(REPO, "BENCH_TPU_engines.json")) as fh:
+        with open(tpu_cache_file(["--engines"])) as fh:
             engines = json.load(fh).get("engines", {})
         ok = {k: v for k, v in engines.items() if isinstance(v, (int, float))}
         best = max(ok, key=ok.get)
@@ -230,7 +230,16 @@ def build_solver_fallback(n_f, nx, nt, widths, fused, tag):
     back to autotune when the hint cannot build (cross-check or lowering
     failure inside ``compile`` is excluded, not fatal).  ``engine_used``
     goes into the payload: measurements under different engines must be
-    distinguishable."""
+    distinguishable.
+
+    Limitation: this only guards the build; a failure when jit later
+    differentiates through the engine (inside ``solver.fit``) is not
+    retried here.  Acceptable because an artifact-derived hint is an
+    engine that already survived a full value_and_grad AOT compile on
+    this hardware in the promoted ``--engines`` run — only BENCH_ENGINE
+    overrides and cross-round toolchain drift carry that risk, and
+    ``bench_jax_throughput`` (whose fallback covers its whole prep) is
+    the mode drivers run unattended."""
     try:
         return build_solver(n_f, nx, nt, widths, fused=fused), repr(fused)
     except Exception as e:
@@ -245,21 +254,37 @@ def build_solver_fallback(n_f, nx, nt, widths, fused, tag):
 def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune"):
     import jax
 
-    solver, engine_used = build_solver_fallback(n_f, nx, nt, widths, fused,
-                                                "jax")
-    train_step, trainables, opt_state = make_sa_step(solver)
+    def prep(fused_arg):
+        solver = build_solver(n_f, nx, nt, widths, fused=fused_arg)
+        train_step, trainables, opt_state = make_sa_step(solver)
+        # ONE AOT compile serves both the cost analysis and the timed loop —
+        # a second jit of the same step would double warm-up inside the
+        # worker's timeout budget
+        t0 = time.time()
+        step = jax.jit(train_step, donate_argnums=(0, 1)) \
+            .lower(trainables, opt_state, solver.X_f).compile()
+        flops_per_step = compiled_flops(step)
+        trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
+        jax.block_until_ready(loss)
+        log(f"[jax] compile+first step: {time.time() - t0:.1f}s "
+            f"(backend={jax.default_backend()}, {len(jax.devices())} "
+            f"device(s))")
+        return solver, step, trainables, opt_state, loss, flops_per_step
 
-    # ONE AOT compile serves both the cost analysis and the timed loop — a
-    # second jit of the same step would double warm-up inside the worker's
-    # timeout budget
-    t0 = time.time()
-    step = jax.jit(train_step, donate_argnums=(0, 1)) \
-        .lower(trainables, opt_state, solver.X_f).compile()
-    flops_per_step = compiled_flops(step)
-    trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
-    jax.block_until_ready(loss)
-    log(f"[jax] compile+first step: {time.time() - t0:.1f}s "
-        f"(backend={jax.default_backend()}, {len(jax.devices())} device(s))")
+    # the fallback covers the WHOLE prep — solver build, the AOT step
+    # compile (which differentiates through the engine; the compile-time
+    # cross-check is forward-only), and the first execution
+    try:
+        solver, step, trainables, opt_state, loss, flops_per_step = prep(fused)
+        engine_used = repr(fused)
+    except Exception as e:
+        if fused == "autotune":
+            raise
+        log(f"[jax] hinted engine fused={fused!r} failed "
+            f"({type(e).__name__}: {e}); falling back to autotune")
+        solver, step, trainables, opt_state, loss, flops_per_step = \
+            prep("autotune")
+        engine_used = "'autotune' (hint failed)"
 
     t0 = time.time()
     for _ in range(n_steps):
@@ -530,6 +555,11 @@ def bench_scale(nx, nt, widths, n_steps, n_f_list=None, on_point=None,
         except Exception as e:
             out[str(n_f)] = {"error": f"{type(e).__name__}: {e}"}
             log(f"[scale] N_f={n_f} FAILED: {out[str(n_f)]['error']}")
+            if fused != "autotune":
+                # whatever failed, don't let a possibly-bad hint compound
+                # across the remaining (larger) points
+                log("[scale] dropping engine hint; autotune from here on")
+                fused = "autotune"
         if on_point is not None:
             on_point(dict(out))
     return out
@@ -602,7 +632,7 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
         log(f"[full] t={t:7.1f}s {phase}@{step}: rel-L2={l2:.3e}")
         if on_eval is not None:
             on_eval({"wall": round(t, 1), "l2": l2, "t_target": t_target,
-                     "timeline": list(timeline)})
+                     "engine": engine_used, "timeline": list(timeline)})
 
     solver.fit(tf_iter=adam_iter, newton_iter=newton_iter,
                eval_fn=eval_fn, eval_every=eval_every)
@@ -829,8 +859,10 @@ def cpu_sanity(timeout):
     """Fresh small CPU measurement (BENCH_FAST config) to attach alongside a
     cached hardware payload — proves the code still runs end-to-end today
     even when the tunnel doesn't."""
+    # BENCH_ENGINE cleared: a TPU-oriented override (e.g. pallas) must
+    # never reach a CPU worker, where it would run in interpret mode
     env = dict(os.environ, BENCH_FAST="1", JAX_PLATFORMS="cpu",
-               PALLAS_AXON_POOL_IPS="")
+               PALLAS_AXON_POOL_IPS="", BENCH_ENGINE="")
     payload, err = run_worker(["--force-cpu"], timeout, env=env)
     if payload is None:
         return {"error": err}
@@ -968,7 +1000,8 @@ def main():
     to = min(attempt_cap, remaining() - 15)
     payload = err = None
     if to > 60:
-        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   BENCH_ENGINE="")
         payload, err = run_worker(mode_flags + ["--force-cpu"], to, env=env)
     else:
         err = "no budget left for a CPU fallback"

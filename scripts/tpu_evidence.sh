@@ -64,8 +64,13 @@ promote engines
 echo "=== 5. on-hardware kernel parity tests ==="
 if [ -s runs/hwtests_tpu.log ] && grep -q "passed" runs/hwtests_tpu.log; then
     echo "already captured"
-else
+elif timeout 120 python -c "
+import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
     timeout 1200 python -m pytest hwtests/ -q 2>&1 | tail -3 | tee runs/hwtests_tpu.log
+else
+    # a wedged tunnel would hang pytest's backend init for the full
+    # timeout; skip and let a later watcher pass retry
+    echo "SKIP: tunnel unhealthy"
 fi
 
 echo "ALL TPU EVIDENCE CAPTURED"

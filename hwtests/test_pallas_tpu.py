@@ -88,6 +88,58 @@ def test_point_cotangent_matches_on_tpu():
     assert float(jnp.max(jnp.abs(gX - rX))) / scale < 1e-5
 
 
+def test_bf16_kernel_matches_bf16_xla_on_tpu():
+    """The mixed-precision kernel (bf16 matmul operands, f32 accumulation
+    — the ``fused_dtype="bfloat16"`` MXU path behind ``bench.py
+    --precision``'s bf16-pallas config) must agree with the XLA Taylor
+    engine under the SAME precision policy: this isolates kernel
+    correctness from bf16 truncation.  A loose f32 cross-check bounds the
+    truncation itself."""
+    layers, X = _setup()
+    keys = sorted(REQS | {()})
+    fn = pallas_taylor.build_pallas_table_fn(REQS, SHAPES, precision=PREC,
+                                             compute_dtype=jnp.bfloat16)
+    out = fn(layers, X)
+    ref16 = taylor_derivatives(layers, X, REQS | {()}, precision=PREC,
+                               compute_dtype=jnp.bfloat16)
+    ref32 = taylor_derivatives(layers, X, REQS | {()}, precision=PREC)
+    for mi in keys:
+        o, r16, r32 = (np.asarray(out[mi]), np.asarray(ref16[mi]),
+                       np.asarray(ref32[mi]))
+        # same-policy engines: differences only from reduction/fusion order
+        scale = np.abs(r16).max() + 1e-8
+        assert np.abs(o - r16).max() / scale < 5e-3, mi
+        # bf16 truncation vs f32 truth: order 1e-2 relative, not garbage
+        scale = np.abs(r32).max() + 1e-8
+        assert np.abs(o - r32).max() / scale < 5e-2, mi
+
+
+def test_bf16_backward_is_finite_and_close_on_tpu():
+    """Gradients through the bf16 kernel drive the Adam phase on hardware
+    — they must be finite and within bf16-class distance of the f32
+    gradients (the L-BFGS phase always runs f32, collocation.py)."""
+    layers, X = _setup()
+    keys = sorted(REQS | {()})
+    fn = pallas_taylor.build_pallas_table_fn(REQS, SHAPES, precision=PREC,
+                                             compute_dtype=jnp.bfloat16)
+
+    def loss_pl(ls):
+        t = fn(ls, X)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    def loss_ref(ls):
+        t = taylor_derivatives(ls, X, REQS | {()}, precision=PREC)
+        return sum(jnp.sum(t[k] ** 2) for k in keys)
+
+    g_pl = jax.grad(loss_pl)(layers)
+    g_ref = jax.grad(loss_ref)(layers)
+    for (gW, gb), (rW, rb) in zip(g_pl, g_ref):
+        assert bool(jnp.all(jnp.isfinite(gW))) and \
+            bool(jnp.all(jnp.isfinite(gb)))
+        scale = float(jnp.max(jnp.abs(rW))) + 1e-8
+        assert float(jnp.max(jnp.abs(gW - rW))) / scale < 5e-2
+
+
 def test_third_order_and_mixed_on_tpu():
     """KdV-style u_xxx and mixed u_xt lower and match on hardware."""
     layers, X = _setup(n=500)

@@ -28,8 +28,11 @@ def main():
     args = example_args(
         "Allen-Cahn coefficient discovery", flags=("no-sa",),
         iters=(0, "override total Adam iters (0 = config default)"),
-        lr_vars=(0.0, "coefficient learning rate (0 = library default; "
-                      "0.01 converges fastest on the full grid)"),
+        lr_vars=("", "coefficient learning rate: one float or a "
+                     "comma-separated per-coefficient list (empty = library "
+                     "default). '2e-5,0.01' matches the c1/c2 scale split — "
+                     "a single rate parks c1 at an Adam noise floor ~10x "
+                     "its 1e-4 target (see DiscoveryModel.compile)"),
         out=("", "write a JSON summary + coefficient trajectory here"))
     use_sa = not args.no_sa
 
@@ -49,7 +52,13 @@ def main():
     col_weights = rng.rand(X.shape[0], 1) if use_sa else None
     widths = [128] * 4 if not args.quick else [32] * 2
 
-    lr_vars_kw = {"lr_vars": args.lr_vars} if args.lr_vars else {}
+    lr_vars_kw = {}
+    if args.lr_vars:
+        vals = [float(s) for s in args.lr_vars.split(",")]
+        if len(vals) > 1:
+            lr_vars_kw = {"lr_vars": vals}
+        elif vals[0] != 0.0:  # bare '0' keeps its old meaning: default
+            lr_vars_kw = {"lr_vars": vals[0]}
 
     def build():
         model = DiscoveryModel()

@@ -71,6 +71,10 @@ class DiscoveryModel:
             ``examples/AC-discovery.py:51``).
           u: observed solution values ``[n, n_out]``.
           var: initial guesses for the unknown coefficients.
+          lr_vars: coefficient learning rate — one float (or optax
+            schedule) shared by all coefficients, or a sequence with one
+            per coefficient for problems whose coefficients live at very
+            different scales (see the per-var note in the source).
           col_weights: optional SA collocation weights ``[n, 1]`` (λ², with
             gradient ascent — reference ``models.py:348,369``).
           varnames: coordinate names for ``grad(u, "x")`` style authoring
@@ -116,17 +120,39 @@ class DiscoveryModel:
         if self.dist:
             self._shard_observations()
 
+        # lr_vars: one float/schedule for every coefficient, or a sequence
+        # with one entry per coefficient.  Per-var rates matter because
+        # Adam normalizes gradient MAGNITUDE but not loss CURVATURE: for
+        # Allen-Cahn discovery ∂f/∂c1 = -u_xx is ~1e4 larger than
+        # ∂f/∂c2 = u³-u, and a single rate big enough to carry c2 to 5.0
+        # parks c1 (true value 1e-4) at a ~lr-sized noise floor 10-100x
+        # its target.  The reference's one-Adam-for-all-vars design
+        # (``models.py:335,370``) cannot express this.
+        if getattr(lr_vars, "ndim", 0) > 0:  # array of rates == sequence
+            lr_vars = [float(v) for v in np.asarray(lr_vars)]
+        per_var = isinstance(lr_vars, (list, tuple))
+        if per_var and len(lr_vars) != len(self.trainables["vars"]):
+            raise ValueError(
+                f"lr_vars has {len(lr_vars)} entries for "
+                f"{len(self.trainables['vars'])} coefficients")
+
         def label_fn(tr):
+            vlab = ([f"var{i}" for i in range(len(tr["vars"]))] if per_var
+                    else jax.tree_util.tree_map(lambda _: "vars", tr["vars"]))
             return {"params": jax.tree_util.tree_map(lambda _: "net", tr["params"]),
-                    "vars": jax.tree_util.tree_map(lambda _: "vars", tr["vars"]),
+                    "vars": vlab,
                     "col_weights": jax.tree_util.tree_map(lambda _: "lam",
                                                           tr["col_weights"])}
 
-        self.opt = optax.multi_transform(
-            {"net": optax.adam(lr, b1=0.99),
-             "vars": optax.adam(lr_vars, b1=0.99),
-             "lam": optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=0.99))},
-            label_fn)
+        transforms = {"net": optax.adam(lr, b1=0.99),
+                      "lam": optax.chain(optax.scale(-1.0),
+                                         optax.adam(lr_weights, b1=0.99))}
+        if per_var:
+            transforms.update({f"var{i}": optax.adam(lv, b1=0.99)
+                               for i, lv in enumerate(lr_vars)})
+        else:
+            transforms["vars"] = optax.adam(lr_vars, b1=0.99)
+        self.opt = optax.multi_transform(transforms, label_fn)
         self.opt_state = self.opt.init(self.trainables)
         self.losses: list[float] = []
         self.var_history: list[list[float]] = []

@@ -50,6 +50,33 @@ def test_discovery_with_sa_col_weights():
     assert np.isfinite(model.losses[-1])
 
 
+def f_model_2var(u, var, x, t):
+    c1, c2 = var
+    u_xx = grad(grad(u, "x"), "x")
+    return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * u(x, t)
+
+
+def test_discovery_per_var_learning_rates():
+    """lr_vars as a sequence: each coefficient gets its own Adam rate —
+    a frozen (lr=0) coefficient must not move while the others train."""
+    x, t, u = synthetic_heat_data(n=200)
+    model = DiscoveryModel()
+    model.compile([2, 16, 1], f_model_2var, [x, t], u, var=[0.1, 0.3],
+                  varnames=["x", "t"], lr_vars=[0.01, 0.0], verbose=False)
+    model.fit(tf_iter=200, chunk=100)
+    c1, c2 = (float(v) for v in model.vars)
+    assert c1 != pytest.approx(0.1), "lr 0.01 coefficient should train"
+    assert c2 == pytest.approx(0.3), "lr 0.0 coefficient must stay frozen"
+
+
+def test_discovery_per_var_lr_length_mismatch_raises():
+    x, t, u = synthetic_heat_data(n=50)
+    with pytest.raises(ValueError, match="lr_vars"):
+        DiscoveryModel().compile([2, 8, 1], f_model, [x, t], u, var=[0.0],
+                                 varnames=["x", "t"], lr_vars=[0.1, 0.1],
+                                 verbose=False)
+
+
 def test_discovery_predict():
     x, t, u = synthetic_heat_data(n=100)
     model = DiscoveryModel()

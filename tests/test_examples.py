@@ -31,7 +31,8 @@ def test_poisson_example_runs():
 
 
 def test_discovery_example_runs():
-    run_example("ac_discovery.py", "--no-sa")
+    # the comma-list --lr_vars exercises the per-coefficient rate parse
+    run_example("ac_discovery.py", "--no-sa", "--lr_vars", "2e-5,0.01")
 
 
 def test_checkpoint_transfer_example_runs(tmp_path):

@@ -88,17 +88,23 @@ elif healthy; then
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== E. KdV soliton (N_f=20k, third-order fused engine, 10k+10k) ==="
-if done_marker runs/kdv_full_tpu.log "Error u"; then echo "done already"
+# kdv.py's success line is "KdV soliton relative L2: ..." — NOT "Error u"
+# (round-3 audit: the old marker never matched, so the step re-ran every
+# watcher pass)
+if done_marker runs/kdv_full_tpu.log "relative L2"; then echo "done already"
 elif healthy; then
     timeout 5400 python examples/kdv.py > runs/kdv_full_tpu.log 2>&1
-    grep -a "Error u" runs/kdv_full_tpu.log || tail -3 runs/kdv_full_tpu.log
+    grep -a "relative L2" runs/kdv_full_tpu.log || tail -3 runs/kdv_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== F. 2D Burgers (N_f=20k 3-D domain, 1k+1k) ==="
-if done_marker runs/burgers2d_full_tpu.log "Error u"; then echo "done already"
+# burgers2d has no analytic truth (like the reference's testing.py): its
+# success line is "final loss: ..." — the old "Error u" marker never
+# matched (round-3 audit)
+if done_marker runs/burgers2d_full_tpu.log "final loss"; then echo "done already"
 elif healthy; then
     timeout 3600 python examples/burgers2d.py > runs/burgers2d_full_tpu.log 2>&1
-    grep -a "Error u" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
+    grep -a "final loss" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== H. AC-SA with the exactly-periodic embedding net (beyond-reference) ==="

@@ -129,7 +129,8 @@ else echo "SKIP: tunnel unhealthy"; fi
 echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="
 if done_marker runs/resample_ablation_tpu.log "improvement"; then echo "done already"
 elif healthy; then
-    timeout 2400 python scripts/resample_ablation.py > runs/resample_ablation_tpu.log 2>&1
+    timeout 2400 python scripts/resample_ablation.py --seeds 3 \
+        > runs/resample_ablation_tpu.log 2>&1
     tail -2 runs/resample_ablation_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 

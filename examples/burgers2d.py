@@ -15,14 +15,20 @@ from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
 
 
 def main():
-    args = example_args("2D+time Burgers-type PDE")
+    args = example_args(
+        "2D+time Burgers-type PDE",
+        nf=(0, "override N_f (0 = config default)"),
+        adam=(0, "override Adam iters (0 = config default)"),
+        newton=(0, "override L-BFGS iters (0 = config default)"),
+        width=(0, "override hidden width (0 = config default)"))
 
     domain = DomainND(["x", "y", "t"], time_var="t")
     fid = 256 if not args.quick else 24
     domain.add("x", [-1.0, 1.0], fid)
     domain.add("y", [-1.0, 1.0], fid)
     domain.add("t", [0.0, 1.0], 100 if not args.quick else 11)
-    domain.generate_collocation_points(scaled(args, 20_000, 1_500), seed=0)
+    domain.generate_collocation_points(args.nf or scaled(args, 20_000, 1_500),
+                                       seed=0)
 
     def func_ic_xy(x, y):
         return -np.sin(np.pi * x) - np.sin(np.pi * y)
@@ -43,11 +49,12 @@ def main():
         return (u_t(x, y, t) + u(x, y, t) * u_x(x, y, t)
                 - (0.05 / np.pi) * u_xx(x, y, t))
 
-    widths = [128] * 4 if not args.quick else [24] * 2
+    w = args.width or (128 if not args.quick else 24)
+    widths = [w] * (4 if not args.quick else 2)
     solver = CollocationSolverND()
     solver.compile([3, *widths, 1], f_model, domain, bcs)
-    solver.fit(tf_iter=scaled(args, 1_000, 100),
-               newton_iter=scaled(args, 1_000, 50))
+    solver.fit(tf_iter=args.adam or scaled(args, 1_000, 100),
+               newton_iter=args.newton or scaled(args, 1_000, 50))
     print(f"final loss: {solver.losses[-1]['Total Loss']:.4e}")
     return solver
 

@@ -29,8 +29,9 @@ User contract (JAX-style, per-point)::
         return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * u(x, t)**3
 
 against observations ``u`` at points ``X`` (reference example:
-``examples/AC-discovery.py:18-26``).  The SA residual weighting uses
-``g(λ)=λ²`` exactly as the reference does (``models.py:348``).
+``examples/AC-discovery.py:18-26``).  The SA residual weighting defaults
+to the reference's ``g(λ)=λ²`` (``models.py:348``); ``compile(g=...)``
+overrides it (e.g. a bounded transform against λ runaway on long runs).
 """
 
 from __future__ import annotations
@@ -46,7 +47,7 @@ import optax
 
 from ..networks import neural_net
 from ..ops.derivatives import make_ufn, vmap_residual
-from ..ops.losses import MSE, g_MSE
+from ..ops.losses import MSE, default_g, g_MSE
 from ..output import print_screen
 from ..training.progress import progress_bar
 
@@ -60,7 +61,7 @@ class DiscoveryModel:
                 lr: float = 0.005, lr_vars: float = 0.005,
                 lr_weights: float = 0.005, seed: int = 0, verbose: bool = True,
                 fused: Optional[bool] = None, dist: bool = False,
-                network=None):
+                network=None, g: Optional[Callable] = None):
         """Assemble the inverse problem (reference ``models.py:325-341``).
 
         Args:
@@ -77,6 +78,12 @@ class DiscoveryModel:
             different scales (see the per-var note in the source).
           col_weights: optional SA collocation weights ``[n, 1]`` (λ², with
             gradient ascent — reference ``models.py:348,369``).
+          g: optional λ transform replacing the reference's fixed
+            ``g(λ)=λ²`` (``models.py:348``).  Beyond-reference: a BOUNDED
+            transform (e.g. ``lambda l: jnp.tanh(l) ** 2``) tames the λ
+            runaway measured on long SA runs, where unbounded ascent
+            degrades the u-fit and biases the recovered coefficients
+            (CONVERGENCE.md, AC discovery per-var-lr rows).
           varnames: coordinate names for ``grad(u, "x")`` style authoring
             (defaults to ``x0, x1, …``).
           fused: residual engine selection, as on the forward solver —
@@ -104,6 +111,7 @@ class DiscoveryModel:
         self.verbose = verbose
         self.fused = fused
         self.dist = dist
+        self.g = g
 
         self.net = network if network is not None else neural_net(layer_sizes)
         self.params = self.net.init(jax.random.PRNGKey(seed),
@@ -240,6 +248,7 @@ class DiscoveryModel:
         X, u_data = self.X, self.u_data
         apply_fn = self.apply_fn
         generic_residual = self._generic_residual
+        g_fn = self.g if self.g is not None else default_g
 
         self._fused_residual = self._try_fuse() if self.fused is not False \
             else None
@@ -283,7 +292,7 @@ class DiscoveryModel:
             for i, p in enumerate(preds):
                 p = p.reshape(-1, 1)
                 if tr["col_weights"] is not None:
-                    term = g_MSE(p, 0.0, tr["col_weights"] ** 2)
+                    term = g_MSE(p, 0.0, g_fn(tr["col_weights"]))
                 else:
                     term = MSE(p, 0.0)
                 comps[f"Residual_{i}" if len(preds) > 1 else "Residual"] = term

@@ -69,6 +69,35 @@ def test_discovery_per_var_learning_rates():
     assert c2 == pytest.approx(0.3), "lr 0.0 coefficient must stay frozen"
 
 
+def test_discovery_g_transform_reaches_the_loss():
+    """g= replaces the fixed lambda^2 in the residual term.  The
+    discriminating probe: with g == 0 the residual term vanishes, so the
+    coefficient gradient is exactly zero and the coefficient cannot move
+    — if g were silently ignored (default lambda^2 path), it would."""
+    import jax.numpy as jnp
+
+    x, t, u = synthetic_heat_data(n=200)
+    cw = np.random.RandomState(2).rand(200, 1)
+    model = DiscoveryModel()
+    model.compile([2, 16, 1], f_model, [x, t], u, var=[0.1],
+                  col_weights=cw, varnames=["x", "t"],
+                  g=lambda lam: jnp.zeros_like(lam), verbose=False)
+    model.fit(tf_iter=100, chunk=50)
+    assert float(model.vars[0]) == pytest.approx(0.1), \
+        "g==0 must zero the residual term; the coefficient moved, so g= " \
+        "did not reach the loss"
+    assert np.isfinite(model.losses[-1])
+
+    # and a bounded transform trains normally (λ ascends, loss finite)
+    model2 = DiscoveryModel()
+    model2.compile([2, 16, 1], f_model, [x, t], u, var=[0.1],
+                   col_weights=cw, varnames=["x", "t"],
+                   g=lambda lam: jnp.tanh(lam) ** 2, verbose=False)
+    model2.fit(tf_iter=100, chunk=50)
+    assert float(model2.vars[0]) != pytest.approx(0.1)
+    assert np.isfinite(model2.losses[-1])
+
+
 def test_discovery_per_var_lr_length_mismatch_raises():
     x, t, u = synthetic_heat_data(n=50)
     with pytest.raises(ValueError, match="lr_vars"):

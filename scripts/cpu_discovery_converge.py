@@ -36,8 +36,14 @@ TOTAL = int(os.environ.get("DISC_ITERS", 12_000))
 # while c1 converged to 9.4e-5 under its own rate.  Plain MSE keeps the
 # fit stable; c1 no longer needs λ's interface emphasis.
 SA = os.environ.get("DISC_SA", "1") != "0"
+# DISC_G=tanh2 bounds the SA residual weight via g(λ)=tanh(λ)² (the
+# compile(g=...) knob added after the λ-runaway diagnosis): λ may ascend
+# without bound, but its LOSS weight cannot exceed 1 — testing whether
+# this keeps the u-fit stable where the default λ² run drained c2.
+G_NAME = os.environ.get("DISC_G", "")
 LEG = 3_000
-_SUF = "" if SA else "_nosa"   # keep the two variants' artifacts apart
+# keep every variant's artifacts apart
+_SUF = ("" if SA else "_nosa") + (f"_{G_NAME}" if G_NAME else "")
 CKPT = os.path.join(ROOT, "runs", f"discovery_converge_ckpt{_SUF}")
 OUT = os.path.join(ROOT, "runs", f"cpu_discovery_converge{_SUF}.json")
 
@@ -69,10 +75,16 @@ def main():
     # climbed (c1=1.8e-3 at iter 6000, runs/ archive) — Adam normalizes
     # gradient magnitude, not curvature, and |∂f/∂c1|=|u_xx| is ~1e4
     # larger than |∂f/∂c2|.  Rate each coefficient at its own scale.
+    g = None
+    if G_NAME == "tanh2":
+        import jax.numpy as jnp
+        g = lambda lam: jnp.tanh(lam) ** 2  # noqa: E731
+    elif G_NAME:
+        raise ValueError(f"unknown DISC_G={G_NAME!r} (supported: tanh2)")
     model.compile([2, 64, 64, 64, 64, 1], f_model,
                   [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
                   col_weights=rng.rand(X.shape[0], 1) if SA else None,
-                  varnames=["x", "t"],
+                  varnames=["x", "t"], g=g,
                   lr_vars=[2e-5, 0.01], verbose=False)
 
     done = 0
@@ -94,7 +106,7 @@ def main():
 
     c1, c2 = (float(v) for v in model.vars)
     traj = model.var_history[::10]
-    out = {"grid": f"{len(x)}x{len(t)}", "net": "2-64x4-1", "sa": SA,
+    out = {"grid": f"{len(x)}x{len(t)}", "net": "2-64x4-1", "sa": SA, "g": G_NAME or "lambda^2 (default)",
            "adam": done, "lr_vars": "2e-5,0.01 (per-var)",
            "c1": c1, "c1_true": 0.0001, "c1_abs_err": abs(c1 - 0.0001),
            "c2": c2, "c2_true": 5.0,

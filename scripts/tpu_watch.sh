@@ -17,6 +17,8 @@ if ! flock -n 9; then
     exit 0
 fi
 
+HIST=runs/tunnel_history.log   # append-only probe record (audit + trend)
+
 while true; do
     echo "probing $(date +%H:%M:%S)" > "$STATE"
     if timeout 120 python -c "
@@ -25,6 +27,7 @@ assert jax.devices()[0].platform != 'cpu'
 (jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()
 print('healthy')
 " 2>/dev/null | grep -q healthy; then
+        echo "$(date -u +%F\ %T) healthy" >> "$HIST"
         echo "healthy $(date +%H:%M:%S) — running evidence suite" > "$STATE"
         bash scripts/tpu_evidence.sh >> runs/tpu_evidence_watch.log 2>&1
         bash scripts/tpu_convergence_extra.sh >> runs/tpu_extra_watch.log 2>&1
@@ -43,6 +46,7 @@ print('healthy')
         fi
         echo "suite incomplete $(date +%H:%M:%S); will re-pass" > "$STATE"
     else
+        echo "$(date -u +%F\ %T) unhealthy" >> "$HIST"
         echo "unhealthy $(date +%H:%M:%S); retrying in 300s" > "$STATE"
     fi
     sleep 300

@@ -225,30 +225,47 @@ def compiled_flops(compiled):
         return None
 
 
-def build_solver_fallback(n_f, nx, nt, widths, fused, tag):
+def build_solver_fallback(n_f, nx, nt, widths, fused, tag, grad_probe=False):
     """``(solver, engine_used)`` — build with the hinted engine, falling
     back to autotune when the hint cannot build (cross-check or lowering
     failure inside ``compile`` is excluded, not fatal).  ``engine_used``
     goes into the payload: measurements under different engines must be
     distinguishable.
 
-    Limitation: this only guards the build; a failure when jit later
-    differentiates through the engine (inside ``solver.fit``) is not
-    retried here.  Acceptable because an artifact-derived hint is an
-    engine that already survived a full value_and_grad AOT compile on
-    this hardware in the promoted ``--engines`` run — only BENCH_ENGINE
-    overrides and cross-round toolchain drift carry that risk, and
-    ``bench_jax_throughput`` (whose fallback covers its whole prep) is
-    the mode drivers run unattended."""
+    ``grad_probe=True`` additionally AOT-compiles ``value_and_grad``
+    through the hinted engine at the real shapes before returning, so a
+    hint that builds but fails when jit later differentiates through it
+    (stale BENCH_ENGINE override, cross-round toolchain drift) falls back
+    to autotune *here* instead of killing a long ``--full`` run 0 s in.
+    One extra compile when hinted — and the persistent compile cache
+    (``tensordiffeq_tpu.utils.enable_compilation_cache``) keeps it warm
+    for later passes.  Modes whose own prep already AOT-compiles the step
+    (``bench_jax_throughput``) skip the probe."""
+    def build(f):
+        solver = build_solver(n_f, nx, nt, widths, fused=f)
+        if grad_probe and f != "autotune":
+            import jax
+            tr = {"params": solver.params, "lambdas": solver.lambdas}
+
+            def loss_over(t):
+                return solver.loss_fn(t["params"], t["lambdas"]["BCs"],
+                                      t["lambdas"]["residual"], solver.X_f)
+
+            t0 = time.time()
+            jax.jit(jax.value_and_grad(loss_over, has_aux=True)) \
+                .lower(tr).compile()
+            log(f"[{tag}] grad-probe through fused={f!r} ok "
+                f"({time.time() - t0:.1f}s)")
+        return solver
+
     try:
-        return build_solver(n_f, nx, nt, widths, fused=fused), repr(fused)
+        return build(fused), repr(fused)
     except Exception as e:
         if fused == "autotune":
             raise
         log(f"[{tag}] hinted engine fused={fused!r} failed "
             f"({type(e).__name__}: {e}); falling back to autotune")
-        return build_solver(n_f, nx, nt, widths, fused="autotune"), \
-            "'autotune' (hint failed)"
+        return build("autotune"), "'autotune' (hint failed)"
 
 
 def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune"):
@@ -606,7 +623,7 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
     u_star = usol.reshape(-1, 1)
 
     solver, engine_used = build_solver_fallback(n_f, nx, nt, widths, fused,
-                                                "full")
+                                                "full", grad_probe=True)
     timeline = []
     t_target = None
     Xg_j = None  # device copy, created lazily on first eval

@@ -138,6 +138,10 @@ def test_bf16_backward_is_finite_and_close_on_tpu():
             bool(jnp.all(jnp.isfinite(gb)))
         scale = float(jnp.max(jnp.abs(rW))) + 1e-8
         assert float(jnp.max(jnp.abs(gW - rW))) / scale < 5e-2
+        # bias cotangents get the same closeness bar as the weights — a
+        # wrong-but-finite bias gradient must fail, not pass (ADVICE r3)
+        scale_b = float(jnp.max(jnp.abs(rb))) + 1e-8
+        assert float(jnp.max(jnp.abs(gb - rb))) / scale_b < 5e-2
 
 
 def test_third_order_and_mixed_on_tpu():

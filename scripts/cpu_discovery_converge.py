@@ -44,7 +44,11 @@ G_NAME = os.environ.get("DISC_G", "")
 LEG = 3_000
 # keep every variant's artifacts apart
 _SUF = ("" if SA else "_nosa") + (f"_{G_NAME}" if G_NAME else "")
-CKPT = os.path.join(ROOT, "runs", f"discovery_converge_ckpt{_SUF}")
+# the ckpt dir additionally carries a config token (full-x grid + per-var
+# lr labels): a leftover checkpoint from an older grid/optimizer layout
+# must never be restored into this one (ADVICE r3) — and restore is
+# belt-and-braces guarded below so an incompatible dir starts fresh
+CKPT = os.path.join(ROOT, "runs", f"discovery_converge_ckpt{_SUF}_fx512pv")
 OUT = os.path.join(ROOT, "runs", f"cpu_discovery_converge{_SUF}.json")
 
 
@@ -89,9 +93,14 @@ def main():
 
     done = 0
     if os.path.isdir(CKPT):
-        model.restore_checkpoint(CKPT)
-        done = len(model.var_history)
-        print(f"[discovery] resumed at iter {done}", flush=True)
+        try:
+            model.restore_checkpoint(CKPT)
+            done = len(model.var_history)
+            print(f"[discovery] resumed at iter {done}", flush=True)
+        except Exception as e:
+            print(f"[discovery] checkpoint in {CKPT} incompatible with this "
+                  f"config ({type(e).__name__}: {e}); starting fresh",
+                  flush=True)
 
     t0 = time.time()
     while done < TOTAL:

@@ -1,0 +1,103 @@
+"""NTK (Adaptive_type=3) and causal weighting vs the non-adaptive control
+at EQUAL budget — Allen-Cahn, the stiff benchmark both features target.
+
+Round-3 verdict: both features are implemented and unit-tested but carry
+no accuracy evidence ("implemented-but-unproven is the reference's own
+NTK story one notch up").  This run closes the loop: three arms on an
+identical reduced AC config (same net init seed, same collocation draw,
+same Adam+L-BFGS budget), rel-L2 against the spectral fixture.
+
+Arms:
+  control — plain MSE, no weighting (the reference's non-adaptive path)
+  ntk     — per-term NTK trace balancing, recomputed every chunk
+            (the reference DECLARES this mode but ships it dead,
+            reference ``models.py:76-84``)
+  causal  — causal_eps=1.0, 32 time bins (Wang et al. 2203.07404;
+            beyond-reference)
+
+Reduced scale (CPU-core-feasible): N_f=8192, 2-64x3-1, 6k Adam + 2k
+L-BFGS.  The interesting quantity is the GAP between arms at equal
+budget, which is scale-portable evidence the weighting earns its keep
+(the same protocol the round-2 SA-vs-vanilla hedge used).
+
+Crash-safe: each arm writes its own JSON on completion and is skipped on
+re-run; the combined table lands in runs/weighting_ablation.json.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           python scripts/cpu_weighting_ablation.py
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "examples"))
+
+N_F = 8_192
+WIDTHS = [64, 64, 64]
+ADAM, NEWTON = 6_000, 2_000
+OUT = os.path.join(ROOT, "runs", "weighting_ablation.json")
+
+
+def run_arm(name):
+    import numpy as np
+
+    from ac_baseline import build_problem
+
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import CollocationSolverND
+    from tensordiffeq_tpu.exact import allen_cahn_solution
+
+    domain, bcs, f_model = build_problem(N_F, nx=512, nt=201, seed=0)
+    kw = {}
+    if name == "ntk":
+        kw = dict(Adaptive_type=3)
+    elif name == "causal":
+        kw = dict(causal_eps=1.0, causal_bins=32)
+
+    solver = CollocationSolverND(verbose=False)
+    solver.compile([2, *WIDTHS, 1], f_model, domain, bcs, **kw)
+    t0 = time.time()
+    solver.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    x, t, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    l2 = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"arm": name, "rel_l2": l2, "wall_s": round(wall, 1),
+            "config": f"AC N_f={N_F}, 2-64x3-1, {ADAM}+{NEWTON}, seed 0"}
+
+
+def main():
+    results = {}
+    for name in ("control", "ntk", "causal"):
+        part = os.path.join(ROOT, "runs", f"weighting_{name}.json")
+        if os.path.exists(part):
+            with open(part) as fh:
+                results[name] = json.load(fh)
+            print(f"[{name}] cached: rel-L2={results[name]['rel_l2']:.3e}",
+                  flush=True)
+            continue
+        print(f"[{name}] running...", flush=True)
+        results[name] = run_arm(name)
+        with open(part, "w") as fh:
+            json.dump(results[name], fh)
+        print(f"[{name}] rel-L2={results[name]['rel_l2']:.3e} "
+              f"({results[name]['wall_s']:.0f}s)", flush=True)
+
+    ctrl = results["control"]["rel_l2"]
+    out = {"arms": results,
+           "ntk_gain_vs_control": round(ctrl / results["ntk"]["rel_l2"], 3),
+           "causal_gain_vs_control":
+               round(ctrl / results["causal"]["rel_l2"], 3)}
+    with open(OUT, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "arms"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

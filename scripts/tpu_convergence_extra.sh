@@ -52,27 +52,30 @@ echo "=== C. Allen-Cahn discovery (512x201 grid, 12k Adam, per-var lr) ==="
 # and drains c2 (SA: c2 4.91→4.03, loss 2.3e-4→7.3e-3; no-SA: c2=5.0000
 # exactly at 6k with loss still falling).  The headline run is therefore
 # no-SA; the reference-example SA config is captured separately below.
-if done_marker runs/ac_discovery_full_tpu.log "c1 = " \
-        && [ -s runs/ac_discovery_full_tpu.json ]; then echo "done already"
+# artifact names carry the config token (nosa12k): a log completed under
+# an earlier config can never satisfy this config's done-marker, and the
+# filename alone says which config produced it (ADVICE r3)
+if done_marker runs/ac_discovery_full_nosa12k_tpu.log "c1 = " \
+        && [ -s runs/ac_discovery_full_nosa12k_tpu.json ]; then echo "done already"
 elif healthy; then
     timeout 5400 python examples/ac_discovery.py \
         --no-sa --iters 12000 --lr_vars 2e-5,0.01 \
-        --out runs/ac_discovery_full_tpu.json \
-        > runs/ac_discovery_full_tpu.log 2>&1
-    grep -a "c1 = " runs/ac_discovery_full_tpu.log || tail -3 runs/ac_discovery_full_tpu.log
+        --out runs/ac_discovery_full_nosa12k_tpu.json \
+        > runs/ac_discovery_full_nosa12k_tpu.log 2>&1
+    grep -a "c1 = " runs/ac_discovery_full_nosa12k_tpu.log || tail -3 runs/ac_discovery_full_nosa12k_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== C2. Allen-Cahn discovery, SA parity config (reference example) ==="
 # the reference's own AC-discovery.py uses SA col_weights at 10k iters;
 # capture it at exactly that budget for the parity record
-if done_marker runs/ac_discovery_sa_tpu.log "c1 = " \
-        && [ -s runs/ac_discovery_sa_tpu.json ]; then echo "done already"
+if done_marker runs/ac_discovery_sa10k_tpu.log "c1 = " \
+        && [ -s runs/ac_discovery_sa10k_tpu.json ]; then echo "done already"
 elif healthy; then
     timeout 5400 python examples/ac_discovery.py \
         --iters 10000 --lr_vars 2e-5,0.01 \
-        --out runs/ac_discovery_sa_tpu.json \
-        > runs/ac_discovery_sa_tpu.log 2>&1
-    grep -a "c1 = " runs/ac_discovery_sa_tpu.log || tail -3 runs/ac_discovery_sa_tpu.log
+        --out runs/ac_discovery_sa10k_tpu.json \
+        > runs/ac_discovery_sa10k_tpu.log 2>&1
+    grep -a "c1 = " runs/ac_discovery_sa10k_tpu.log || tail -3 runs/ac_discovery_sa10k_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== D. single-chip N_f scaling sweep (50k..500k) ==="

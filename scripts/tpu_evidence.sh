@@ -4,11 +4,15 @@
 # whose canonical artifact already exists is skipped, so the watcher can
 # re-pass after a mid-suite tunnel death and only fill the gaps.
 #
-# ORDER (round-3): headline capture first (~8-10 min with the cached TF
-# baseline), then the north-star AC-SA time-to-L2 run — if the tunnel
-# yields exactly one good window it must land those two, not the short
-# secondary captures.  The AC-SA run streams per-eval snapshots so even a
-# truncated window salvages a partial; precision/engines/hwtests follow.
+# ORDER (round-4, per VERDICT): the north-star AC-SA time-to-L2 run goes
+# FIRST — the headline throughput is already cached and loses little by
+# aging, while the full-size convergence artifact is the single number the
+# project exists to produce.  The AC-SA run streams per-eval snapshots so
+# even a truncated window salvages a partial.  Then the precision axis
+# (bf16 MFU — the measured lever), then the engine-hinted headline
+# refresh (fast: the promoted engines artifact skips autotune), engines,
+# hwtests.  The persistent XLA compile cache (utils.enable_compilation_
+# cache, round 4) makes every re-pass cheaper than the last.
 #
 # Results are written to runs/<name>.new first and only promoted to the
 # canonical BENCH_TPU_<name>.json when they are real TPU measurements
@@ -27,19 +31,10 @@ export BENCH_NO_CPU_FALLBACK=1
 echo "=== 0. health check ==="
 timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 
-echo "=== 1. headline throughput (autotune now includes pallas) ==="
-# always re-run: the tracked artifact predates the pallas autotune fix, and
-# promote() only replaces it with a real TPU measurement.  The watcher run
-# gets a bigger budget than the driver default (1140s): pallas-inclusive
-# autotune plus the AOT compile is ~8-12 min of compiles through the tunnel.
-BENCH_BUDGET=1700 timeout 1800 python bench.py \
-    > runs/default.new 2> runs/bench_default_tpu.log
-promote default
-
-echo "=== 2. AC-SA full convergence (10k Adam + 10k L-BFGS) — north star ==="
-# Runs SECOND (round-3 reorder): if the tunnel yields exactly one good
-# window this round, it must land the time-to-L2 artifact, not four short
-# captures.  Streamed per-eval snapshots make a truncated run salvageable.
+echo "=== 1. AC-SA full convergence (10k Adam + 10k L-BFGS) — north star ==="
+# Runs FIRST (round-4 reorder, per the judge): if the tunnel yields exactly
+# one good window this round, it must land the time-to-L2 artifact.
+# Streamed per-eval snapshots make a truncated run salvageable.
 # BENCH_BUDGET sits inside the outer timeout so bench.py always gets to
 # print its JSON line (and salvage streamed partials) before the kill.
 if have_complete full; then echo "already captured"; else
@@ -48,12 +43,22 @@ if have_complete full; then echo "already captured"; else
     promote full
 fi
 
-echo "=== 3. precision axis (incl bf16-taylor + bf16-pallas) ==="
+echo "=== 2. precision axis (incl bf16-taylor + bf16-pallas) ==="
+# the bf16 single-pass MXU path is the measured MFU lever (PERF.md
+# roofline); its hardware capture is round-4 priority #2
 if have_complete precision; then echo "already captured"; else
     BENCH_BUDGET=2300 timeout 2500 python bench.py --precision \
         > runs/precision.new 2> runs/bench_precision_tpu.log
     promote precision
 fi
+
+echo "=== 3. headline throughput (engine-hinted: skips autotune) ==="
+# always re-run: the tracked artifact predates the pallas autotune fix, and
+# promote() only replaces it with a real TPU measurement.  With the
+# promoted engines artifact as hint this is a single compile, not 4.
+BENCH_BUDGET=1700 timeout 1800 python bench.py \
+    > runs/default.new 2> runs/bench_default_tpu.log
+promote default
 
 echo "=== 4. engines ==="
 # always re-run (old artifact lacks the backend field); promote-gated

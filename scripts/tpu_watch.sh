@@ -40,7 +40,7 @@ print('healthy')
             && grep -q "passed" runs/hwtests_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/ac_baseline_full_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/burgers_full_tpu.log 2>/dev/null \
-            && grep -aq "c1 = " runs/ac_discovery_full_tpu.log 2>/dev/null; then
+            && grep -aq "c1 = " runs/ac_discovery_full_nosa12k_tpu.log 2>/dev/null; then
             echo "done $(date +%H:%M:%S)" > "$STATE"
             exit 0
         fi

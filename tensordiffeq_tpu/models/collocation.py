@@ -120,6 +120,8 @@ class CollocationSolverND:
             with SA λ; per-epoch ``Causal_w_last_j`` in the loss history
             reports completeness (→1 when the whole horizon trains).
         """
+        from ..utils import enable_compilation_cache
+        enable_compilation_cache()  # warm process starts skip XLA compiles
         if domain.X_f is None:
             raise ValueError("Domain has no collocation points; call "
                              "domain.generate_collocation_points(N_f) first")

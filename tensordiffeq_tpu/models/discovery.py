@@ -93,6 +93,8 @@ class DiscoveryModel:
             devices; coefficients and network replicate.
           network: optional custom Flax module replacing the default MLP.
         """
+        from ..utils import enable_compilation_cache
+        enable_compilation_cache()  # warm process starts skip XLA compiles
         if isinstance(X, (list, tuple)):
             X = np.hstack([np.reshape(c, (-1, 1)) for c in X])
         self.X = jnp.asarray(X, jnp.float32)

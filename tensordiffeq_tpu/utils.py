@@ -13,6 +13,8 @@ what JAX makes native:
 
 from __future__ import annotations
 
+import os
+import tempfile
 from typing import Any, Optional
 
 import jax
@@ -22,6 +24,58 @@ from jax.flatten_util import ravel_pytree
 
 from .ops.losses import MSE, g_MSE  # re-export for parity  # noqa: F401
 from .sampling import LatinHypercubeSample  # noqa: F401
+
+
+_compile_cache_dir: Optional[str] = None
+_compile_cache_wired = False
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache (idempotent).
+
+    Every process start otherwise pays full XLA compile cost — the round-3
+    head-to-head lost ~100 s of time-to-first-accuracy to compiles, and
+    each TPU tunnel window burns minutes recompiling programs it already
+    compiled the window before.  A disk cache keyed on (program, backend)
+    makes warm starts skip that entirely.
+
+    Resolution order: explicit ``path`` arg > ``TDQ_COMPILE_CACHE`` env
+    (``0``/``off`` disables) > a per-user dir under the system temp dir.
+    Called automatically by ``CollocationSolverND.compile`` /
+    ``DiscoveryModel.compile``; safe to call repeatedly or before backend
+    init.  Returns the cache dir in use, or ``None`` when disabled.
+    """
+    global _compile_cache_dir, _compile_cache_wired
+    env = os.environ.get("TDQ_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "false", "none"):
+        return None
+    if path is None:
+        if _compile_cache_wired:  # auto-call must never clobber an earlier
+            return _compile_cache_dir  # explicit enable_compilation_cache(p)
+        already = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if already:  # ... nor a user-configured jax cache dir
+            _compile_cache_dir, _compile_cache_wired = already, True
+            return already
+        uid = getattr(os, "getuid", lambda: "")()
+        path = env or os.path.join(tempfile.gettempdir(),
+                                   f"tdq_xla_cache_{uid}")
+    if _compile_cache_wired and path == _compile_cache_dir:
+        return _compile_cache_dir
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache any program over 0.5 s of compile: the train-step programs
+        # (seconds on CPU, minutes through a TPU tunnel) all clear it, while
+        # trivial executables stay out (XLA's CPU AOT loader logs two
+        # machine-feature lines per loaded entry — caching hundreds of tiny
+        # programs would drown stderr for no win)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        return None  # old jax / unsupported backend: run uncached
+    _compile_cache_dir = path
+    _compile_cache_wired = True
+    return path
 
 
 def constant(val, dtype=jnp.float32):

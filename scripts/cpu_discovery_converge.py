@@ -41,9 +41,19 @@ SA = os.environ.get("DISC_SA", "1") != "0"
 # without bound, but its LOSS weight cannot exceed 1 — testing whether
 # this keeps the u-fit stable where the default λ² run drained c2.
 G_NAME = os.environ.get("DISC_G", "")
+# DISC_TSUB: time-axis subsample stride (8 -> t[::8] = 26 slices, the
+# round-3 CPU-feasible grid; 1 -> the reference's FULL 512x201 grid).
+# DISC_BATCH: observation minibatch size (0 = full batch).  The full grid
+# is ~103k rows — full-batch is ~8x the 512x26 step cost and days on one
+# CPU core, but minibatched at DISC_BATCH~12864 each step costs the same
+# as the 512x26 full-batch step while the optimizer sees every row each
+# 8-step sweep (DiscoveryModel.fit(batch_sz=...), round-4 capability).
+TSUB = int(os.environ.get("DISC_TSUB", 8))
+BATCH = int(os.environ.get("DISC_BATCH", 0))
 LEG = 3_000
 # keep every variant's artifacts apart
-_SUF = ("" if SA else "_nosa") + (f"_{G_NAME}" if G_NAME else "")
+_SUF = ("" if SA else "_nosa") + (f"_{G_NAME}" if G_NAME else "") \
+    + (f"_t{TSUB}" if TSUB != 8 else "") + (f"_b{BATCH}" if BATCH else "")
 # the ckpt dir additionally carries a config token (full-x grid + per-var
 # lr labels): a leftover checkpoint from an older grid/optimizer layout
 # must never be restored into this one (ADVICE r3) — and restore is
@@ -62,7 +72,7 @@ def main():
     # 512-point x-grid (dx=0.0039, the reference's resolution) keeps the
     # interfaces; t[::8] (26 slices) is benign — AC dynamics are smooth in
     # t — and keeps the row count CPU-feasible.
-    x, t, usol = x, t[::8], usol[:, ::8]
+    x, t, usol = x, t[::TSUB], usol[:, ::TSUB]
     X = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
     u_star = usol.reshape(-1, 1)
 
@@ -105,7 +115,7 @@ def main():
     t0 = time.time()
     while done < TOTAL:
         n = min(LEG, TOTAL - done)
-        model.fit(tf_iter=n)
+        model.fit(tf_iter=n, batch_sz=BATCH or None)
         done += n
         model.save_checkpoint(CKPT)
         c1, c2 = (float(v) for v in model.vars)

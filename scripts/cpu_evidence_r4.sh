@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 CPU evidence queue (serial: ONE core on this host — memory rule:
+# never two heavy jobs at once).  Waits for the h2h rerun, then runs the
+# VERDICT-priority order: full-grid discovery (V3) -> NTK/causal ablation
+# (V6) -> KdV full config (V5).  Every step is idempotent: completed
+# artifacts are skipped on re-run, and the per-arm/per-leg checkpoints
+# inside each job bound what a kill can lose.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+while pgrep -f "h2h_rerun_r4.py" > /dev/null; do sleep 60; done
+
+echo "=== A. AC discovery, FULL 512x201 grid, minibatched (12k Adam) ==="
+# the reference's own config (AC-discovery.py:14,51-66) needs multi-GPU;
+# DiscoveryModel.fit(batch_sz=12864) sweeps the full grid in 8-step
+# rotations at the 512x26 run's per-step cost.  no-SA + per-var lr — the
+# round-3 converged recipe (also the TPU extras step C config).
+if [ -s runs/cpu_discovery_converge_nosa_t1_b12864.json ]; then
+    echo "done already"
+else
+    env DISC_SA=0 DISC_TSUB=1 DISC_BATCH=12864 DISC_ITERS=12000 \
+        timeout 21600 nice -n 19 python scripts/cpu_discovery_converge.py \
+        > runs/cpu_discovery_fullgrid.log 2>&1
+    tail -2 runs/cpu_discovery_fullgrid.log
+fi
+
+echo "=== B. NTK + causal weighting vs control (equal budget) ==="
+if [ -s runs/weighting_ablation.json ]; then
+    echo "done already"
+else
+    timeout 18000 nice -n 19 python scripts/cpu_weighting_ablation.py \
+        > runs/weighting_ablation.log 2>&1
+    tail -2 runs/weighting_ablation.log
+fi
+
+echo "=== C. KdV soliton FULL config (N_f=20k, 10k+10k) ==="
+if grep -aq "relative L2" runs/kdv_full_cpu.log 2>/dev/null; then
+    echo "done already"
+else
+    timeout 21600 nice -n 19 python examples/kdv.py \
+        > runs/kdv_full_cpu.log 2>&1
+    grep -a "relative L2" runs/kdv_full_cpu.log || tail -2 runs/kdv_full_cpu.log
+fi
+
+echo "CPU EVIDENCE R4 DONE"

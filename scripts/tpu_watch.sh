@@ -26,11 +26,11 @@ import jax, jax.numpy as jnp
 assert jax.devices()[0].platform != 'cpu'
 (jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()
 print('healthy')
-" 2>/dev/null | grep -q healthy; then
+" 9<&- 2>/dev/null | grep -q healthy; then
         echo "$(date -u +%F\ %T) healthy" >> "$HIST"
         echo "healthy $(date +%H:%M:%S) — running evidence suite" > "$STATE"
-        bash scripts/tpu_evidence.sh >> runs/tpu_evidence_watch.log 2>&1
-        bash scripts/tpu_convergence_extra.sh >> runs/tpu_extra_watch.log 2>&1
+        bash scripts/tpu_evidence.sh 9<&- >> runs/tpu_evidence_watch.log 2>&1
+        bash scripts/tpu_convergence_extra.sh 9<&- >> runs/tpu_extra_watch.log 2>&1
         # a mid-suite tunnel death leaves gaps — keep watching until the
         # core artifacts exist AND are complete (have_complete: a promoted
         # gap-filler partial must keep the watcher alive for the re-run)
@@ -49,5 +49,8 @@ print('healthy')
         echo "$(date -u +%F\ %T) unhealthy" >> "$HIST"
         echo "unhealthy $(date +%H:%M:%S); retrying in 300s" > "$STATE"
     fi
-    sleep 300
+    # 9<&- : children must NOT inherit the lock fd — a sleep/evidence child
+    # outliving a killed watcher would block every relaunch for minutes
+    # (round-4 incident: an orphaned `sleep 300` held the lock)
+    sleep 300 9<&-
 done

@@ -49,6 +49,7 @@ from ..networks import neural_net
 from ..ops.derivatives import make_ufn, vmap_residual
 from ..ops.losses import MSE, default_g, g_MSE
 from ..output import print_screen
+from ..training.fit import make_batches
 from ..training.progress import progress_bar
 
 
@@ -246,11 +247,12 @@ class DiscoveryModel:
                                     u_primal)
 
     # ------------------------------------------------------------------ #
-    def _build(self):
+    def _build(self, batch_sz=None):
         X, u_data = self.X, self.u_data
         apply_fn = self.apply_fn
         generic_residual = self._generic_residual
         g_fn = self.g if self.g is not None else default_g
+        self._built_batch = batch_sz
 
         self._fused_residual = self._try_fuse() if self.fused is not False \
             else None
@@ -279,43 +281,84 @@ class DiscoveryModel:
                           "engine")
         fused_res = self._fused_residual
 
-        def loss_fn(tr):
+        # minibatching (round 4): the reference trains the inverse problem
+        # full-batch only; batch_sz slices the observation rows so the full
+        # 512x201 reference grid (~103k rows) trains at a bounded per-step
+        # cost.  Per-row SA col_weights are gathered alongside their batch
+        # rows; only those rows receive a gradient each step (out-of-batch
+        # rows still drift on decayed Adam moments between their turns —
+        # the same semantics as the forward solver's minibatch+SA path).
+        # Single device: ceil-batching with wraparound, so NO row is ever
+        # dropped (the tail batch wraps to the front of the set).  dist:
+        # make_batches' mesh-aware per-shard layout (device-multiple trim,
+        # as on the forward solver).
+        mesh = None
+        if self.dist:
+            from ..parallel import make_mesh
+            mesh = make_mesh()
+        N = int(X.shape[0])
+        if mesh is None and batch_sz and batch_sz < N:
+            n_batches = -(-N // int(batch_sz))  # ceil: keep every row
+            idx = np.arange(n_batches * int(batch_sz)) % N
+            X_batched = jnp.take(X, jnp.asarray(idx), axis=0).reshape(
+                n_batches, int(batch_sz), -1)
+            idx_batched = jnp.asarray(idx).reshape(n_batches, int(batch_sz))
+        else:
+            X_batched, idx_batched, n_batches = make_batches(
+                X, batch_sz, mesh=mesh, verbose=self.verbose)
+
+        def loss_parts(tr, X_b, u_b, cw_b):
             if fused_res is not None:
                 # primal u(X) comes out of the same Taylor propagation the
                 # residual uses — one network traversal serves both losses
-                f_pred, u_pred = fused_res(tr["params"], X, tr["vars"])
+                f_pred, u_pred = fused_res(tr["params"], X_b, tr["vars"])
             else:
-                u_pred = apply_fn(tr["params"], X)
-                f_pred = generic_residual(tr["params"], tr["vars"], X)
+                u_pred = apply_fn(tr["params"], X_b)
+                f_pred = generic_residual(tr["params"], tr["vars"], X_b)
             preds = f_pred if isinstance(f_pred, tuple) else (f_pred,)
-            data_loss = MSE(u_pred, u_data)
+            data_loss = MSE(u_pred, u_b)
             comps = {"Data": data_loss}
             res_loss = 0.0
             for i, p in enumerate(preds):
                 p = p.reshape(-1, 1)
-                if tr["col_weights"] is not None:
-                    term = g_MSE(p, 0.0, g_fn(tr["col_weights"]))
+                if cw_b is not None:
+                    term = g_MSE(p, 0.0, g_fn(cw_b))
                 else:
                     term = MSE(p, 0.0)
                 comps[f"Residual_{i}" if len(preds) > 1 else "Residual"] = term
                 res_loss = res_loss + term
             return data_loss + res_loss, comps
 
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        def loss_fn(tr):
+            """Full-set loss (public contract; also the eval/cross-check
+            path) — identical maths to the batched training loss."""
+            return loss_parts(tr, X, u_data, tr["col_weights"])
+
+        def loss_batch(tr, X_b, idx_b):
+            if n_batches == 1:
+                return loss_parts(tr, X, u_data, tr["col_weights"])
+            cw = tr["col_weights"]
+            return loss_parts(tr, X_b, u_data[idx_b],
+                              None if cw is None else cw[idx_b])
+
+        grad_fn = jax.value_and_grad(loss_batch, has_aux=True)
         opt = self.opt
 
         @partial(jax.jit, static_argnames=("n_steps",))
-        def run_chunk(trainables, opt_state, n_steps: int):
-            def step(carry, _):
+        def run_chunk(trainables, opt_state, step0, n_steps: int):
+            def step(carry, i):
                 trainables, opt_state = carry
-                (total, _), grads = grad_fn(trainables)
+                b = (step0 + i) % n_batches
+                X_b = X_batched[b] if n_batches > 1 else X_batched[0]
+                idx_b = idx_batched[b] if n_batches > 1 else idx_batched[0]
+                (total, _), grads = grad_fn(trainables, X_b, idx_b)
                 updates, opt_state = opt.update(grads, opt_state, trainables)
                 trainables = optax.apply_updates(trainables, updates)
                 return (trainables, opt_state), (total,
                                                  [v for v in trainables["vars"]])
 
             (trainables, opt_state), (losses, var_hist) = jax.lax.scan(
-                step, (trainables, opt_state), None, length=n_steps)
+                step, (trainables, opt_state), jnp.arange(n_steps))
             return trainables, opt_state, losses, var_hist
 
         self._run_chunk = run_chunk
@@ -332,12 +375,27 @@ class DiscoveryModel:
         cw = self.trainables["col_weights"]
         return None if cw is None else np.asarray(cw)
 
-    def fit(self, tf_iter: int, chunk: int = 100):
-        """Joint Adam training loop (reference ``models.py:381-398``)."""
-        self.train_loop(tf_iter, chunk=chunk)
+    def fit(self, tf_iter: int, chunk: int = 100,
+            batch_sz: Optional[int] = None):
+        """Joint Adam training loop (reference ``models.py:381-398``).
+
+        ``batch_sz`` (beyond-reference) minibatches the observation rows:
+        each step trains on one contiguous batch, rotating through the
+        set with a wraparound tail batch (every row trains every sweep;
+        under ``dist`` the set is instead trimmed to a device multiple).
+        Per-row SA ``col_weights`` ride with their rows — note that
+        between a row's turns its λ still drifts on decayed Adam moments
+        (standard sparse-gradient Adam; a bounded ``g=`` transform caps
+        the loss-side effect).  Batches rotate continuously across
+        ``fit`` calls and checkpoint resumes (the step counter persists
+        via the loss history)."""
+        self.train_loop(tf_iter, chunk=chunk, batch_sz=batch_sz)
         return self
 
-    def train_loop(self, tf_iter: int, chunk: int = 100):
+    def train_loop(self, tf_iter: int, chunk: int = 100,
+                   batch_sz: Optional[int] = None):
+        if getattr(self, "_built_batch", None) != batch_sz:
+            self._build(batch_sz)  # re-jit only when the batch layout changes
         if self.verbose:
             print_screen(self, discovery_model=True)
         t0 = time.time()
@@ -346,7 +404,8 @@ class DiscoveryModel:
         while done < tf_iter:
             n = int(min(chunk, tf_iter - done))
             self.trainables, self.opt_state, losses, var_hist = self._run_chunk(
-                self.trainables, self.opt_state, n)
+                self.trainables, self.opt_state,
+                jnp.asarray(len(self.losses), jnp.int32), n)
             self.losses.extend(np.asarray(losses).tolist())
             stacked = [np.asarray(v) for v in var_hist]
             for i in range(n):

@@ -250,3 +250,66 @@ def test_discovery_resume_matches_uninterrupted(tmp_path):
     m_b.fit(tf_iter=30, chunk=30)
     np.testing.assert_allclose(float(m_b.vars[0]), float(m_full.vars[0]),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_discovery_minibatch_trains_and_rotates():
+    """batch_sz (beyond-reference) slices observation rows; the batched
+    run must train (loss down, coefficient toward truth) and the batch
+    rotation must continue across fit calls."""
+    x, t, u = synthetic_heat_data(n=512)
+    m = DiscoveryModel()
+    m.compile([2, 20, 20, 1], f_model, [x, t], u, var=[0.0],
+              varnames=["x", "t"], verbose=False)
+    m.fit(tf_iter=400, chunk=100, batch_sz=128)
+    assert m.losses[-1] < m.losses[0]
+    assert abs(float(m.vars[0]) - TRUE_C) < abs(0.0 - TRUE_C)
+    # a later fit with a different batch layout re-jits and keeps training
+    m.fit(tf_iter=100, chunk=50, batch_sz=256)
+    assert len(m.losses) == 500
+
+
+def test_discovery_minibatch_equals_fullbatch_when_batch_covers_set():
+    """batch_sz >= n rows must take the n_batches==1 path and reproduce
+    the full-batch trajectory exactly."""
+    x, t, u = synthetic_heat_data(n=128)
+    runs = []
+    for bs in (None, 128, 500):
+        m = DiscoveryModel()
+        m.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+                  varnames=["x", "t"], verbose=False)
+        m.fit(tf_iter=40, chunk=20, batch_sz=bs)
+        runs.append((m.losses, float(m.vars[0])))
+    for losses, c in runs[1:]:
+        np.testing.assert_allclose(losses, runs[0][0], rtol=1e-6)
+        np.testing.assert_allclose(c, runs[0][1], rtol=1e-6)
+
+
+def test_discovery_minibatch_composes_with_sa_weights():
+    """Per-row SA col_weights gather with their batch rows: every row's
+    lambda must have moved after enough steps to cover all batches."""
+    x, t, u = synthetic_heat_data(n=256)
+    rng = np.random.RandomState(1)
+    init_cw = rng.rand(256, 1)
+    m = DiscoveryModel()
+    m.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+              varnames=["x", "t"], verbose=False,
+              col_weights=init_cw.copy())
+    m.fit(tf_iter=64, chunk=32, batch_sz=64)  # 4 batches, 16 full passes
+    moved = np.abs(m.col_weights - init_cw).reshape(-1)
+    assert (moved > 0).all(), f"{(moved == 0).sum()} rows never updated"
+
+
+def test_discovery_minibatch_wraparound_keeps_all_rows():
+    """A batch size that does not divide the row count must still train
+    every row (ceil-batching with a wraparound tail, not a silent drop)."""
+    x, t, u = synthetic_heat_data(n=250)  # 250 % 64 != 0
+    rng = np.random.RandomState(2)
+    init_cw = rng.rand(250, 1)
+    m = DiscoveryModel()
+    m.compile([2, 10, 1], f_model, [x, t], u, var=[0.1],
+              varnames=["x", "t"], verbose=False,
+              col_weights=init_cw.copy())
+    m.fit(tf_iter=64, chunk=32, batch_sz=64)  # ceil -> 4 batches of 64
+    moved = np.abs(m.col_weights - init_cw).reshape(-1)
+    assert (moved > 0).all(), \
+        f"{(moved == 0).sum()} rows (incl. the tail) never trained"

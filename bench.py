@@ -145,7 +145,7 @@ def engine_hint(default="autotune"):
 
 
 def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
-                 precision=_UNSET, fused_dtype=None):
+                 precision=_UNSET, fused_dtype=None, remat=False):
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, grad, periodicBC
 
@@ -187,7 +187,7 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
         dict_adaptive={"residual": [True], "BCs": [True, False]},
         init_weights={"residual": [rng.rand(n_f, 1)],
                       "BCs": [100.0 * rng.rand(nx, 1), None]},
-        fused=fused, network=network, fused_dtype=fused_dtype)
+        fused=fused, network=network, fused_dtype=fused_dtype, remat=remat)
     return solver
 
 
@@ -268,11 +268,13 @@ def build_solver_fallback(n_f, nx, nt, widths, fused, tag, grad_probe=False):
         return build("autotune"), "'autotune' (hint failed)"
 
 
-def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune"):
+def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune",
+                         remat=False):
     import jax
 
     def prep(fused_arg):
-        solver = build_solver(n_f, nx, nt, widths, fused=fused_arg)
+        solver = build_solver(n_f, nx, nt, widths, fused=fused_arg,
+                              remat=remat)
         train_step, trainables, opt_state = make_sa_step(solver)
         # ONE AOT compile serves both the cost analysis and the timed loop —
         # a second jit of the same step would double warm-up inside the
@@ -325,7 +327,8 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune"):
     return {"pts_per_sec_per_chip": pts, "steps_per_sec": steps_per_sec,
             "flops_per_step": flops_per_step, "mfu": mfu,
             "device_kind": dev_kind, "backend": jax.default_backend(),
-            "engine": engine_used, "loss": float(loss)}
+            "engine": engine_used + ("+remat" if remat else ""),
+            "loss": float(loss)}
 
 
 # --------------------------------------------------------------------------- #
@@ -537,6 +540,15 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
 # --------------------------------------------------------------------------- #
 # --scale: single-chip throughput vs collocation-point count
 # --------------------------------------------------------------------------- #
+def _looks_oom(e: Exception) -> bool:
+    """True for XLA/TPU out-of-memory failures in their usual disguises."""
+    import re
+    s = f"{type(e).__name__}: {e}".lower()
+    return bool("resource_exhausted" in s or "resource exhausted" in s
+                or "out of memory" in s or re.search(r"\boom\b", s)
+                or ("allocation" in s and "exceed" in s))
+
+
 def bench_scale(nx, nt, widths, n_steps, n_f_list=None, on_point=None,
                 fused="autotune"):
     """Sweep N_f up to the reference's *distributed* config (AC-dist-new.py:
@@ -560,8 +572,19 @@ def bench_scale(nx, nt, widths, n_steps, n_f_list=None, on_point=None,
     for n_f in n_f_list:
         steps = max(10, n_steps * n_f_list[0] // n_f)
         try:
-            r = bench_jax_throughput(n_f, nx, nt, widths, steps, fused=fused)
-            if r["engine"].endswith("(hint failed)"):
+            try:
+                r = bench_jax_throughput(n_f, nx, nt, widths, steps,
+                                         fused=fused)
+            except Exception as e:
+                if not _looks_oom(e):
+                    raise
+                # HBM exhausted at this size: retry with the remat lever
+                # (compile(remat=True) — backward recomputes the residual
+                # chain instead of storing it) before giving up the point
+                log(f"[scale] N_f={n_f} OOM ({e}); retrying with remat")
+                r = bench_jax_throughput(n_f, nx, nt, widths, steps,
+                                         fused=fused, remat=True)
+            if "(hint failed)" in r["engine"]:  # also matches "...+remat"
                 # don't re-fail a known-bad hinted engine on every
                 # remaining (larger, slower-compiling) sweep point
                 fused = "autotune"

@@ -67,7 +67,8 @@ def build_loss_fn(apply_fn: Callable,
                   causal_eps: Optional[float] = None,
                   causal_bins: int = 32,
                   time_index: Optional[int] = None,
-                  time_bounds: Optional[tuple] = None) -> Callable:
+                  time_bounds: Optional[tuple] = None,
+                  remat: bool = False) -> Callable:
     """Assemble ``loss(params, lam_bcs, lam_res, X_batch)``.
 
     Args:
@@ -88,6 +89,16 @@ def build_loss_fn(apply_fn: Callable,
         enabled when ``causal_eps`` is set; ``time_index`` is the time
         column of ``X_batch`` and ``time_bounds`` its range.  Composes
         with per-point SA λ (applied inside the bin means).
+      remat: rematerialize the residual evaluation in the backward pass
+        (``jax.checkpoint``).  The residual's higher-order derivative
+        chain is the memory-dominant intermediate at large ``N_f`` —
+        several activation-sized buffers per Taylor/jvp order, all live
+        until the backward pass — and on TPU the HBM ceiling, not FLOPs,
+        caps points-per-chip.  Rematerialization stores only the inputs
+        and recomputes the chain during backward: peak memory drops by
+        roughly the chain multiplicity for one extra forward evaluation
+        of FLOPs (the classic compute-for-HBM trade).  Identical maths;
+        pair with ``fit(batch_sz=)`` to push ``N_f`` further.
 
     Returns a pure function
     ``loss(params, lam_bcs, lam_res, X_batch, lam_data=None) ->
@@ -124,6 +135,15 @@ def build_loss_fn(apply_fn: Callable,
         data_X = jnp.asarray(data_X, jnp.float32)
         data_s = jnp.asarray(data_s, jnp.float32)
 
+    def _residual_eval(params, X_batch):
+        if residual_fn is not None:
+            return residual_fn(params, X_batch)
+        u_local = make_ufn(apply_fn, params, varnames, n_out)
+        return vmap_residual(f_model, u_local, ndim)(X_batch)
+
+    if remat:
+        _residual_eval = jax.checkpoint(_residual_eval)
+
     def loss(params, lam_bcs, lam_res, X_batch, lam_data=None):
         u = make_ufn(apply_fn, params, varnames, n_out)
         components: dict[str, jnp.ndarray] = {}
@@ -156,10 +176,7 @@ def build_loss_fn(apply_fn: Callable,
             components[f"BC_{i}"] = loss_bc
             loss_bcs = loss_bcs + loss_bc
 
-        if residual_fn is not None:
-            f_preds = _as_tuple(residual_fn(params, X_batch))
-        else:
-            f_preds = _as_tuple(vmap_residual(f_model, u, ndim)(X_batch))
+        f_preds = _as_tuple(_residual_eval(params, X_batch))
         loss_res = 0.0
         for j, f_pred in enumerate(f_preds):
             f_pred = f_pred.reshape(-1, 1)

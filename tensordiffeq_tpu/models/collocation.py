@@ -72,7 +72,8 @@ class CollocationSolverND:
                 g: Optional[Callable] = None, dist: bool = False,
                 network=None, lr: float = 0.005, lr_weights: float = 0.005,
                 fused: Optional[bool] = None, fused_dtype=None,
-                causal_eps: Optional[float] = None, causal_bins: int = 32):
+                causal_eps: Optional[float] = None, causal_bins: int = 32,
+                remat: bool = False):
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
@@ -112,6 +113,12 @@ class CollocationSolverND:
             to the Adam phase only: L-BFGS line searches break down on
             bf16 gradient noise, so the Newton refinement phase always
             runs a full-precision engine.
+          remat: rematerialize the residual chain in the backward pass
+            (``jax.checkpoint`` — see :func:`..models.assembly.
+            build_loss_fn`): ~chain-multiplicity lower peak memory for one
+            extra forward of FLOPs, the standard HBM lever for pushing
+            ``N_f`` per chip (beyond-reference; the reference splits large
+            ``N_f`` across GPUs instead, ``AC-dist-new.py:14``).
           causal_eps / causal_bins: temporal-causality weighting of the
             residual (Wang et al. arXiv:2203.07404, beyond-reference) —
             residual bin ``b`` along time is weighted
@@ -148,6 +155,7 @@ class CollocationSolverND:
         self.fused = fused
         self.causal_eps = causal_eps
         self.causal_bins = causal_bins
+        self.remat = remat
         self._causal_kw = {} if causal_eps is None else dict(
             causal_eps=causal_eps, causal_bins=causal_bins,
             time_index=domain.vars.index(domain.time_var),
@@ -342,7 +350,7 @@ class CollocationSolverND:
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, weight_outside_sum=self.weight_outside_sum,
                 g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=res_fn, **self._causal_kw)
+                residual_fn=res_fn, remat=self.remat, **self._causal_kw)
 
             def value_grad(params, X):
                 return jax.value_and_grad(
@@ -505,7 +513,8 @@ class CollocationSolverND:
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
             data_X=self.data_X, data_s=self.data_s,
-            residual_fn=self._fused_residual, **self._causal_kw)
+            residual_fn=self._fused_residual, remat=self.remat,
+            **self._causal_kw)
 
         # L-BFGS refinement loss: line searches break down on bf16 gradient
         # noise (a second-order method amplifies ~5% derivative error into
@@ -521,7 +530,7 @@ class CollocationSolverND:
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, weight_outside_sum=self.weight_outside_sum,
                 g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=f32_res, **self._causal_kw)
+                residual_fn=f32_res, remat=self.remat, **self._causal_kw)
 
         # jit-cached inference paths (params are traced args, so repeated
         # predict() calls reuse one compiled program)

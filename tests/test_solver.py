@@ -362,3 +362,45 @@ def test_causal_type2_with_g_matches_noncausal_semantics():
     la, _ = a.loss_fn(a.params, a.lambdas["BCs"], a.lambdas["residual"], a.X_f)
     lb, _ = b.loss_fn(b.params, b.lambdas["BCs"], b.lambdas["residual"], b.X_f)
     np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+
+
+def test_remat_identical_loss_and_grads():
+    """compile(remat=True) (beyond-reference, the HBM lever) must be a pure
+    memory/compute trade: identical loss and gradients on both engines."""
+    import jax
+    import jax.numpy as jnp
+
+    def build(remat, fused):
+        domain = DomainND(["x", "t"], time_var="t")
+        domain.add("x", [-1.0, 1.0], 64)
+        domain.add("t", [0.0, 1.0], 16)
+        domain.generate_collocation_points(512, seed=0)
+        bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])]
+
+        def f_model(u, x, t):
+            return (grad(u, "t")(x, t) + u(x, t) * grad(u, "x")(x, t)
+                    - 0.01 * grad(grad(u, "x"), "x")(x, t))
+
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 12, 12, 1], f_model, domain, bcs,
+                  remat=remat, fused=fused)
+        return s
+
+    for fused in (False, None):
+        a, b = build(False, fused), build(True, fused)
+
+        def gv(s):
+            return jax.value_and_grad(
+                lambda p: s.loss_fn(p, s.lambdas["BCs"],
+                                    s.lambdas["residual"], s.X_f)[0])(s.params)
+
+        (la, ga), (lb, gb) = gv(a), gv(b)
+        assert abs(float(la) - float(lb)) < 1e-6
+        for x, y in zip(jax.tree_util.tree_leaves(ga),
+                        jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_allclose(x, y, atol=1e-6)
+
+    # and it trains end-to-end
+    s = build(True, None)
+    s.fit(tf_iter=60, newton_iter=0)
+    assert s.losses[-1]["Total Loss"] < s.losses[0]["Total Loss"]

@@ -513,7 +513,9 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
             kw.setdefault("fused", False)
             solver = build_solver(n_f, nx, nt, widths, **kw)
             train_step, trainables, opt_state = make_sa_step(solver)
-            step = jax.jit(train_step, donate_argnums=(0, 1))
+            step = jax.jit(train_step, donate_argnums=(0, 1)) \
+                .lower(trainables, opt_state, solver.X_f).compile()
+            flops_per_step = compiled_flops(step)
             trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
             jax.block_until_ready(loss)
             t0 = time.time()
@@ -525,12 +527,23 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
             loss = float(loss)
             if name == "f32-highest":
                 ref_loss = loss
+            # MFU on the engine's NATURAL precision basis: XLA's cost
+            # analysis counts the flops of the program as lowered (the
+            # six-pass f32-HIGHEST decomposition counts 6x, a single-pass
+            # bf16 matmul 1x), so flops/s ÷ the chip's bf16 MXU peak is
+            # comparable across precision configs
+            mfu = None
+            if flops_per_step is not None and jax.default_backend() == "tpu":
+                peak = peak_flops_for(jax.devices()[0].device_kind)
+                if peak:
+                    mfu = flops_per_step * (n_steps / dt) / n_chips / peak
             out[name] = {"pts_per_sec": n_f * n_steps / dt / n_chips,
                          "loss": loss,
+                         "mfu": (round(mfu, 4) if mfu is not None else None),
                          "loss_drift": (None if ref_loss is None
                                         else abs(loss - ref_loss))}
             log(f"[precision] {name}: {out[name]['pts_per_sec']:,.0f} "
-                f"pts/s/chip, loss={loss:.6f}")
+                f"pts/s/chip, loss={loss:.6f}, mfu={mfu}")
         except Exception as e:
             out[name] = {"error": f"{type(e).__name__}: {e}"}
             log(f"[precision] {name} FAILED: {out[name]['error']}")
@@ -637,7 +650,16 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
     """``on_eval(snapshot)`` fires at every periodic evaluation so the
     worker can stream partial payloads — a tunnel death 80 minutes into
     the full run must still leave the rel-L2 progress on record (the
-    supervisor's salvage path tags the last streamed line "partial")."""
+    supervisor's salvage path tags the last streamed line "partial").
+
+    Cross-window resume: the run checkpoints its full training state
+    every eval (``fit(checkpoint_dir=)``, ``BENCH_FULL_CKPT`` overrides
+    the location, empty disables) and picks up from the checkpoint on the
+    next invocation — two 45-minute tunnel windows compose into one
+    complete 90-minute north-star run instead of two lost halves.
+    ``wall``/timeline times are cumulative PRODUCTIVE time across
+    windows (tunnel downtime between windows excluded, ``windows``
+    counts the attempts)."""
     from tensordiffeq_tpu.exact import allen_cahn_solution
     from tensordiffeq_tpu.helpers import find_L2_error
 
@@ -647,8 +669,34 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
 
     solver, engine_used = build_solver_fallback(n_f, nx, nt, widths, fused,
                                                 "full", grad_probe=True)
+    ckpt = os.environ.get("BENCH_FULL_CKPT", "runs/full_ckpt")
+    fast = os.environ.get("BENCH_FAST") == "1"
+    if fast and "BENCH_FULL_CKPT" not in os.environ:
+        ckpt = ""  # smoke runs must not seed a resume point for real runs
+    meta_path = os.path.join(ckpt, "bench_meta.json") if ckpt else None
     timeline = []
     t_target = None
+    t_prev = 0.0
+    adam_done = 0
+    windows = 1
+    if ckpt and os.path.exists(os.path.join(ckpt, "tdq_meta.json")):
+        try:
+            solver.restore_checkpoint(ckpt)
+            adam_done = min(len(solver.losses), adam_iter)
+            try:
+                with open(meta_path) as fh:
+                    m = json.load(fh)
+                timeline = list(m.get("timeline", []))
+                t_prev = float(m.get("train_wall", 0.0))
+                t_target = m.get("t_target")
+                windows = int(m.get("windows", 1)) + 1
+            except Exception:
+                pass  # solver state alone still saves the training time
+            log(f"[full] resumed from {ckpt}: {adam_done} Adam epochs, "
+                f"{t_prev:.0f}s productive time, window #{windows}")
+        except Exception as e:
+            log(f"[full] checkpoint in {ckpt} not restorable "
+                f"({type(e).__name__}: {e}); starting fresh")
     Xg_j = None  # device copy, created lazily on first eval
     t0 = time.time()
 
@@ -664,25 +712,46 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
             Xg_j = jnp.asarray(Xg, jnp.float32)
         u_pred = np.asarray(solver._apply_jit(params, Xg_j))
         l2 = float(find_L2_error(u_pred, u_star))
-        t = time.time() - t0
-        timeline.append({"t": round(t, 1), "phase": f"{phase}@{step}",
+        t = t_prev + time.time() - t0
+        abs_step = step + (adam_done if phase == "adam" else 0)
+        timeline.append({"t": round(t, 1), "phase": f"{phase}@{abs_step}",
                          "l2": l2})
         if t_target is None and l2 <= target:
             t_target = round(t, 1)
-        log(f"[full] t={t:7.1f}s {phase}@{step}: rel-L2={l2:.3e}")
+        log(f"[full] t={t:7.1f}s {phase}@{abs_step}: rel-L2={l2:.3e}")
+        if meta_path is not None:
+            # written AFTER fit's same-boundary checkpoint: the resume
+            # meta is never newer than the state it describes
+            try:
+                with open(meta_path, "w") as fh:
+                    json.dump({"timeline": timeline, "train_wall": t,
+                               "t_target": t_target, "windows": windows},
+                              fh)
+            except Exception:
+                pass
         if on_eval is not None:
             on_eval({"wall": round(t, 1), "l2": l2, "t_target": t_target,
-                     "engine": engine_used, "timeline": list(timeline)})
+                     "engine": engine_used, "windows": windows,
+                     "timeline": list(timeline)})
 
-    solver.fit(tf_iter=adam_iter, newton_iter=newton_iter,
-               eval_fn=eval_fn, eval_every=eval_every)
-    wall = time.time() - t0
+    solver.fit(tf_iter=adam_iter - adam_done, newton_iter=newton_iter,
+               eval_fn=eval_fn, eval_every=eval_every,
+               checkpoint_dir=(ckpt or None), checkpoint_every=eval_every)
+    wall = t_prev + time.time() - t0
     u_pred, _ = solver.predict(Xg, best_model=True)
     l2_best = float(find_L2_error(u_pred, u_star))
+    if ckpt:
+        # the run COMPLETED: clear the resume point so a future fresh
+        # measurement can never silently resume this finished run and
+        # report stale cumulative numbers
+        import shutil
+        for d in (ckpt, ckpt + ".old", ckpt + ".tmp"):
+            shutil.rmtree(d, ignore_errors=True)
     log(f"[full] wall={wall:.1f}s best rel-L2={l2_best:.3e} "
-        f"(target {target:g}, reached at t={t_target})")
+        f"(target {target:g}, reached at t={t_target}, "
+        f"{windows} window(s))")
     return {"wall": wall, "l2": l2_best, "t_target": t_target,
-            "engine": engine_used, "timeline": timeline}
+            "engine": engine_used, "windows": windows, "timeline": timeline}
 
 
 # --------------------------------------------------------------------------- #
@@ -753,6 +822,7 @@ def worker_main(args):
                  "vs_baseline": r["l2"], "rel_l2": r["l2"],
                  "time_to_l2_2.1e-2": r["t_target"],
                  "engine": r.get("engine"),
+                 "windows": r.get("windows", 1),
                  "timeline": r["timeline"]}
             return p
 

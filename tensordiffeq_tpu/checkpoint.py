@@ -42,33 +42,65 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
 
     ``meta`` is an optional JSON-serialisable dict stored alongside (losses
     history, iteration counters, …).
+
+    The write is crash-safe: everything lands in a ``<path>.tmp`` sibling
+    first, then swaps in via directory renames.  A process killed at ANY
+    point leaves a restorable checkpoint on disk — either the new one, or
+    the previous one (possibly parked at ``<path>.old``, which
+    :func:`restore_checkpoint` falls back to).  This matters because the
+    mid-run checkpoint hook (``fit(checkpoint_dir=)``) exists precisely
+    for environments that kill processes at arbitrary moments; an
+    overwrite-in-place would put the only resume point in the blast
+    radius of every periodic save.
     """
-    os.makedirs(path, exist_ok=True)
+    import shutil
+
+    path = os.path.abspath(path)
+    tmp, old = path + ".tmp", path + ".old"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
     state = _to_host(state)
     backend = "flax"
     try:
         import orbax.checkpoint as ocp
         ckptr = ocp.StandardCheckpointer()
-        target = os.path.join(os.path.abspath(path), "state")
-        # orbax refuses to overwrite; emulate standard resume semantics
-        if os.path.exists(target):
-            import shutil
-            shutil.rmtree(target)
-        ckptr.save(target, state)
+        ckptr.save(os.path.join(tmp, "state"), state)
         ckptr.wait_until_finished()
         backend = "orbax"
     except Exception:
         import flax.serialization
-        with open(os.path.join(path, _FLAX_FILE), "wb") as fh:
+        with open(os.path.join(tmp, _FLAX_FILE), "wb") as fh:
             fh.write(flax.serialization.to_bytes(state))
-    with open(os.path.join(path, _META), "w") as fh:
+    with open(os.path.join(tmp, _META), "w") as fh:
         json.dump({"backend": backend, "meta": meta or {}}, fh)
+    # swap: park the previous checkpoint, promote the new one, then drop
+    # the parked copy.  Both renames are atomic on POSIX.
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def resolve_checkpoint_dir(path: str) -> str:
+    """The directory a restore should actually read: ``path`` itself, or
+    the parked ``<path>.old`` when a killed save left only that (callers
+    that peek at ``tdq_meta.json`` themselves must use this too)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(os.path.join(path, _META)) \
+            and os.path.exists(os.path.join(path + ".old", _META)):
+        return path + ".old"
+    return path
 
 
 def restore_checkpoint(path: str, template: dict) -> tuple[dict, dict]:
     """Load the state saved under ``path``.  ``template`` provides the pytree
     structure (and, for the orbax path, shape/dtype guidance).  Returns
-    ``(state, meta)``."""
+    ``(state, meta)``.
+
+    If ``path`` is missing but a ``<path>.old`` sibling exists (a save was
+    killed mid-swap), the parked previous checkpoint is restored instead."""
+    path = resolve_checkpoint_dir(path)
     with open(os.path.join(path, _META)) as fh:
         info = json.load(fh)
     if info["backend"] == "orbax":

@@ -606,7 +606,9 @@ class CollocationSolverND:
             eval_fn: Optional[Callable] = None, eval_every: int = 0,
             resample_every: int = 0, resample_pool: int = 4,
             resample_temp: float = 1.0, resample_uniform: float = 0.1,
-            resample_seed: int = 0):
+            resample_seed: int = 0,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 0):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
         ``fit.py:17-102``).
 
@@ -628,6 +630,18 @@ class CollocationSolverND:
         state, L-BFGS curvature memory, and compiled runners stay warm, so
         the measurement is of ONE continuous run.
 
+        ``checkpoint_dir`` + ``checkpoint_every``: save the FULL training
+        state (:meth:`save_checkpoint` — params, λ, Adam moments, loss
+        history) every that many epochs, at chunk boundaries, WITHOUT
+        interrupting the warm compiled run.  A killed process resumes by
+        compiling the same config, :meth:`restore_checkpoint`, and calling
+        ``fit`` with the remaining iteration budget (``len(solver.losses)``
+        says how far it got).  Built for preemptible/intermittent
+        accelerator time, where a 90-minute run must survive the backend
+        dying at minute 80.  During L-BFGS the checkpoint carries the
+        current params (the curvature pairs rebuild in a few iterations on
+        resume).
+
         ``resample_every`` (beyond-reference; :mod:`..ops.resampling`):
         every that many Adam epochs, redraw the N_f collocation points by
         residual-importance sampling from a fresh ``resample_pool``×N_f LHS
@@ -645,6 +659,8 @@ class CollocationSolverND:
                                 batch_sz=batch_sz, newton_eager=newton_eager,
                                 chunk=chunk, eval_fn=eval_fn,
                                 eval_every=eval_every,
+                                checkpoint_dir=checkpoint_dir,
+                                checkpoint_every=checkpoint_every,
                                 resample_every=resample_every,
                                 resample_pool=resample_pool,
                                 resample_temp=resample_temp,
@@ -698,6 +714,30 @@ class CollocationSolverND:
                     self._X_f_host = host
                 return X_new
 
+        ckpt_hook = None
+        if checkpoint_dir is not None and checkpoint_every > 0:
+            from ..checkpoint import save_checkpoint as _save_ck
+
+            def ckpt_hook(trainables, opt_state, epoch):
+                # write directly from the LIVE buffers (solver attributes
+                # only re-sync after the phase; the run's donated buffers
+                # are valid exactly now, at this chunk boundary).  Each
+                # save serialises the full loss history — the restore
+                # contract needs it — so per-save meta cost grows linearly
+                # with epochs trained: ~1 MB at 20k epochs, fine at the
+                # intended every-1000-epochs cadence; don't set
+                # checkpoint_every to single digits on month-long runs
+                state = {"params": trainables["params"],
+                         "lambdas": trainables["lambdas"]}
+                if opt_state is not None:
+                    state["opt_state"] = opt_state
+                _save_ck(checkpoint_dir, state,
+                         {"losses": self.losses,
+                          "min_loss": {k: float(v)
+                                       for k, v in self.min_loss.items()},
+                          "best_epoch": dict(self.best_epoch),
+                          "has_opt_state": opt_state is not None})
+
         result = FitResult()
         result.losses = self.losses
         if tf_iter > 0:
@@ -737,7 +777,8 @@ class CollocationSolverND:
                 callback=(None if eval_fn is None else
                           (lambda e, p: eval_fn("adam", e, p))),
                 callback_every=eval_every,
-                resample_fn=resample_fn, resample_every=resample_every)
+                resample_fn=resample_fn, resample_every=resample_every,
+                state_hook=ckpt_hook, state_hook_every=checkpoint_every)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
             self.best_model["adam"] = result.best_params["adam"]
@@ -746,13 +787,39 @@ class CollocationSolverND:
 
         if newton_iter > 0:
             from ..training.lbfgs import fit_lbfgs
+
+            # one composite callback serves both hooks at their own
+            # cadences (fit_lbfgs exposes a single callback_every).  The
+            # L-BFGS loop runs in chunks, so the callback sees chunk-
+            # aligned iterate counts — each hook fires on CADENCE-BOUNDARY
+            # CROSSINGS (same rule fit_lbfgs itself applies), never on
+            # exact modulo, which a chunk boundary would usually miss.
+            lb_every = min((v for v in (eval_every if eval_fn else 0,
+                                        checkpoint_every if ckpt_hook else 0)
+                            if v > 0), default=0)
+            lb_prev = {"i": 0}
+
+            def lb_callback(i, p):
+                prev, lb_prev["i"] = lb_prev["i"], i
+                # checkpoint BEFORE eval: the resume meta a caller writes
+                # from its eval hook must never describe state newer than
+                # the checkpoint on disk (see fit.py state_hook contract)
+                if ckpt_hook is not None and checkpoint_every > 0 \
+                        and prev // checkpoint_every != i // checkpoint_every:
+                    # params advance; λ and Adam moments ride unchanged, so
+                    # a resume re-enters L-BFGS from the latest iterate
+                    ckpt_hook({"params": p, "lambdas": self.lambdas},
+                              self.opt_state, i)
+                if eval_fn is not None and eval_every > 0 \
+                        and prev // eval_every != i // eval_every:
+                    eval_fn("l-bfgs", i, p)
+
             params, best_params, best_loss, best_iter, lbfgs_losses = fit_lbfgs(
                 self.loss_fn_refine, self.params, self.lambdas, self.X_f,
                 maxiter=newton_iter, verbose=self.verbose,
                 eager=bool(newton_eager),
-                callback=(None if eval_fn is None else
-                          (lambda i, p: eval_fn("l-bfgs", i, p))),
-                callback_every=eval_every)
+                callback=(lb_callback if lb_every > 0 else None),
+                callback_every=lb_every)
             self.params = params
             self.losses.extend(lbfgs_losses)
             self.best_model["l-bfgs"] = best_params
@@ -830,10 +897,14 @@ class CollocationSolverND:
             self.X_f, self.lambdas = shard_data_inputs(
                 self.X_f, self.lambdas, mesh=mesh)
         template = {"params": self.params, "lambdas": self.lambdas}
-        # peek at meta to know whether optimizer moments were saved
+        # peek at meta to know whether optimizer moments were saved (via
+        # resolve_checkpoint_dir so the killed-mid-swap .old fallback the
+        # restore itself applies is honoured here too)
         import json as _json
         import os as _os
-        with open(_os.path.join(path, "tdq_meta.json")) as fh:
+        from ..checkpoint import resolve_checkpoint_dir
+        with open(_os.path.join(resolve_checkpoint_dir(path),
+                                "tdq_meta.json")) as fh:
             has_opt = _json.load(fh)["meta"].get("has_opt_state", False)
         if has_opt:
             opt = make_optimizer(self.lr, self.lr_weights,

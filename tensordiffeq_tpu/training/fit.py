@@ -234,6 +234,8 @@ def fit_adam(loss_fn: Callable,
              callback_every: int = 0,
              resample_fn: Optional[Callable] = None,
              resample_every: int = 0,
+             state_hook: Optional[Callable] = None,
+             state_hook_every: int = 0,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -253,7 +255,14 @@ def fit_adam(loss_fn: Callable,
     collocation redraw (:mod:`..ops.resampling`) at the same chunk-boundary
     cadence.  ``X_new`` must keep the original shape/sharding, so the
     compiled runner and optimizer state carry straight on — only the batch
-    buffers are rebuilt."""
+    buffers are rebuilt.
+
+    ``state_hook(trainables, opt_state, epoch)`` + ``state_hook_every``:
+    chunk-boundary access to the LIVE optimizer state (the solver object
+    only syncs after the phase returns) — the mid-run checkpoint path, so
+    a killed long run resumes instead of restarting.  Fires before
+    ``callback`` at the same boundary, so a checkpoint written here is
+    never newer than the evaluation recorded after it."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
@@ -316,6 +325,10 @@ def fit_adam(loss_fn: Callable,
         if lambda_update_fn is not None and steps_done < total_steps:
             # after any redraw, so NTK balances the points actually trained
             trainables["lambdas"] = lambda_update_fn(trainables["params"])
+        if (state_hook is not None and state_hook_every > 0
+                and prev_epochs // state_hook_every
+                != cur_epochs // state_hook_every):
+            state_hook(trainables, opt_state, cur_epochs)
         if (callback is not None and callback_every > 0
                 and prev_epochs // callback_every != cur_epochs // callback_every):
             callback(cur_epochs, trainables["params"])

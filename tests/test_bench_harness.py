@@ -154,3 +154,37 @@ def test_have_complete_rechecks_partials(tmp_path):
     (tmp_path / "BENCH_TPU_q.json").write_text(
         '{"value": 5, "backend": "tpu"}')
     assert have("q")  # complete: skip
+
+
+def test_looks_oom_classifier():
+    bench = _load_bench()
+    f = bench._looks_oom
+    assert f(RuntimeError("RESOURCE_EXHAUSTED: while allocating..."))
+    assert f(MemoryError("Resource exhausted: Out of memory in HBM"))
+    assert f(RuntimeError("allocation of 4.2GiB would exceed HBM"))
+    assert f(RuntimeError("OOM when allocating tensor"))
+    # word-boundary: 'zoom' (the L-BFGS line search) must NOT match
+    assert not f(ValueError("strong-Wolfe zoom failed to bracket"))
+    assert not f(TypeError("unsupported operand"))
+
+
+def test_scale_retries_oom_point_with_remat(monkeypatch):
+    bench = _load_bench()
+    calls = []
+
+    def fake_throughput(n_f, nx, nt, widths, steps, fused="autotune",
+                        remat=False):
+        calls.append((n_f, remat))
+        if n_f >= 4096 and not remat:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return {"pts_per_sec_per_chip": 123.0, "mfu": None,
+                "engine": repr(fused) + ("+remat" if remat else "")}
+
+    monkeypatch.setattr(bench, "bench_jax_throughput", fake_throughput)
+    out = bench.bench_scale(8, 8, [8], 10, n_f_list=[2048, 4096],
+                            fused="autotune")
+    # small point ran plain; big point OOM'd then succeeded under remat
+    assert (2048, False) in calls and (4096, False) in calls \
+        and (4096, True) in calls
+    assert out["4096"]["engine"].endswith("+remat")
+    assert out["4096"]["pts_per_sec"] == 123

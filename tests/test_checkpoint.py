@@ -238,3 +238,29 @@ def test_ntk_checkpoint_roundtrip(tmp_path):
     # resumed state is directly trainable
     s2.fit(tf_iter=5, newton_iter=0, chunk=5)
     assert np.isfinite(float(s2.losses[-1]["Total Loss"]))
+
+
+def test_midrun_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """fit(checkpoint_dir=, checkpoint_every=) writes the LIVE state at
+    chunk boundaries; a killed run resumed in a fresh solver must replay
+    the uninterrupted trajectory exactly (the cross-tunnel-window resume
+    path of bench --full)."""
+    ck = str(tmp_path / "midck")
+
+    ctrl = make_solver()
+    ctrl.fit(tf_iter=90, chunk=15)
+
+    a = make_solver()  # "killed" at epoch 60; checkpoints every 30
+    a.fit(tf_iter=60, chunk=15, checkpoint_dir=ck, checkpoint_every=30)
+
+    b = make_solver()  # fresh process analogue
+    b.restore_checkpoint(ck)
+    assert len(b.losses) == 60
+    b.fit(tf_iter=30, chunk=15)
+    assert len(b.losses) == 90
+    np.testing.assert_allclose(b.losses[-1]["Total Loss"],
+                               ctrl.losses[-1]["Total Loss"],
+                               rtol=1e-5)
+    # λ kept ascending through the resume (SA state survived)
+    assert not np.allclose(np.asarray(b.lambdas["residual"][0]),
+                           np.asarray(a.lambdas["residual"][0]))

@@ -1,0 +1,86 @@
+"""End-to-end accuracy of the bf16 fused engine vs f32 at equal budget.
+
+The bf16 single-pass MXU path is the framework's MFU lever (PERF.md
+roofline); its one-step loss drift is measured at 9.2e-5, but no
+CONVERGENCE row shows a full training run landing at the same rel-L2.
+This closes that: Burgers, identical config/seed, one arm
+``fused_dtype="bfloat16"`` (Adam phase on bf16 matmuls; the L-BFGS phase
+auto-runs f32 — the documented design), one arm full f32.  The deliverable
+is the rel-L2 GAP, which is backend-portable evidence the precision mode
+is a real training configuration, not a throughput-only stunt.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           python scripts/cpu_bf16_accuracy.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+OUT = os.path.join(ROOT, "runs", "bf16_accuracy.json")
+N_F, ADAM, NEWTON = 8_192, 4_000, 2_000
+
+
+def run_arm(fused_dtype):
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
+                                  dirichletBC, grad)
+    from tensordiffeq_tpu.exact import burgers_solution
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(N_F, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, 0.0, "x", "upper"),
+           dirichletBC(domain, 0.0, "x", "lower")]
+
+    def f_model(u, x, t):
+        return (grad(u, "t")(x, t) + u(x, t) * grad(u, "x")(x, t)
+                - (0.01 / np.pi) * grad(grad(u, "x"), "x")(x, t))
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 20, 20, 20, 20, 1], f_model, domain, bcs,
+              fused=True, fused_dtype=fused_dtype)
+    t0 = time.time()
+    s.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    x, t, usol = burgers_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = s.predict(Xg, best_model=True)
+    l2 = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"fused_dtype": fused_dtype or "float32", "rel_l2": l2,
+            "wall_s": round(wall, 1)}
+
+
+def main():
+    results = {}
+    for name, dt in (("f32", None), ("bf16", "bfloat16")):
+        part = os.path.join(ROOT, "runs", f"bf16_acc_{name}.json")
+        if os.path.exists(part):
+            with open(part) as fh:
+                results[name] = json.load(fh)
+        else:
+            print(f"[{name}] running...", flush=True)
+            results[name] = run_arm(dt)
+            with open(part, "w") as fh:
+                json.dump(results[name], fh)
+        print(f"[{name}] rel-L2={results[name]['rel_l2']:.3e}", flush=True)
+    out = {"config": f"Burgers N_f={N_F}, 2-20x4-1, {ADAM}+{NEWTON}, seed 0",
+           "arms": results,
+           "bf16_over_f32_l2_ratio":
+               round(results["bf16"]["rel_l2"] / results["f32"]["rel_l2"], 3)}
+    with open(OUT, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "arms"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

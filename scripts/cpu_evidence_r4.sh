@@ -44,4 +44,13 @@ else
     grep -a "relative L2" runs/kdv_full_cpu.log || tail -2 runs/kdv_full_cpu.log
 fi
 
+echo "=== D. bf16 fused engine end-to-end accuracy vs f32 ==="
+if [ -s runs/bf16_accuracy.json ]; then
+    echo "done already"
+else
+    timeout 14400 nice -n 19 python scripts/cpu_bf16_accuracy.py \
+        > runs/bf16_accuracy.log 2>&1
+    tail -2 runs/bf16_accuracy.log
+fi
+
 echo "CPU EVIDENCE R4 DONE"

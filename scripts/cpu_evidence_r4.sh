@@ -17,8 +17,11 @@ echo "=== A. AC discovery, FULL 512x201 grid, minibatched (12k Adam) ==="
 # DiscoveryModel.fit(batch_sz=12864) sweeps the full grid in 8-step
 # rotations at the 512x26 run's per-step cost.  no-SA + per-var lr — the
 # round-3 converged recipe (also the TPU extras step C config).
-if [ -s runs/cpu_discovery_converge_nosa_t1_b12864.json ]; then
-    echo "done already"
+if [ -s runs/cpu_discovery_converge_nosa_t1_b12864.json ] \
+        || [ -s runs/cpu_discovery_fullgrid_slabbatch.json ]; then
+    # done — or attempted and superseded by the permuted-batch rerun,
+    # which runs as step E so the VERDICT-priority arms B-D go first
+    echo "done/superseded (rerun is step E)"
 else
     env DISC_SA=0 DISC_TSUB=1 DISC_BATCH=12864 DISC_ITERS=12000 \
         timeout 21600 nice -n 19 python scripts/cpu_discovery_converge.py \
@@ -51,6 +54,21 @@ else
     timeout 14400 nice -n 19 python scripts/cpu_bf16_accuracy.py \
         > runs/bf16_accuracy.log 2>&1
     tail -2 runs/bf16_accuracy.log
+fi
+
+echo "=== E. full-grid discovery RERUN with permuted batches ==="
+# step A's first attempt batched contiguous rows — on the meshgrid-ordered
+# 512x201 grid each batch was a thin x-slab, and the spatially biased
+# gradients oscillated c2 (3.1 -> 1.6 over the last leg;
+# runs/cpu_discovery_fullgrid_slabbatch.json is the preserved negative
+# result).  DiscoveryModel now permutes the batch index map; rerun.
+if [ -s runs/cpu_discovery_converge_nosa_t1_b12864.json ]; then
+    echo "done already"
+else
+    env DISC_SA=0 DISC_TSUB=1 DISC_BATCH=12864 DISC_ITERS=12000 \
+        timeout 21600 nice -n 19 python scripts/cpu_discovery_converge.py \
+        > runs/cpu_discovery_fullgrid.log 2>&1
+    tail -2 runs/cpu_discovery_fullgrid.log
 fi
 
 echo "CPU EVIDENCE R4 DONE"

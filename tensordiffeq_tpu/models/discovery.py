@@ -299,13 +299,27 @@ class DiscoveryModel:
         N = int(X.shape[0])
         if mesh is None and batch_sz and batch_sz < N:
             n_batches = -(-N // int(batch_sz))  # ceil: keep every row
-            idx = np.arange(n_batches * int(batch_sz)) % N
+            # batches are PERMUTED subsets, not contiguous row blocks:
+            # observation grids come meshgrid-ordered (x-major), so a
+            # contiguous batch is a thin x-slab of the domain — measured
+            # on the 512x201 AC grid to destabilise the coefficients
+            # (spatially biased gradients oscillated c2 from 3.1 back to
+            # 1.6 over one leg).  A fixed seeded shuffle makes every
+            # batch domain-covering; deterministic, so batches replay
+            # identically across fit calls and checkpoint resumes.
+            perm = np.random.RandomState(0).permutation(N)
+            idx = perm[np.arange(n_batches * int(batch_sz)) % N]
             X_batched = jnp.take(X, jnp.asarray(idx), axis=0).reshape(
                 n_batches, int(batch_sz), -1)
             idx_batched = jnp.asarray(idx).reshape(n_batches, int(batch_sz))
         else:
+            # dist path: make_batches' mesh-aware layout with permute=True —
+            # observation grids are ordered, and contiguous per-shard
+            # blocks would be the same slab pathology (within-block
+            # shuffle keeps the λ gather device-local)
             X_batched, idx_batched, n_batches = make_batches(
-                X, batch_sz, mesh=mesh, verbose=self.verbose)
+                X, batch_sz, mesh=mesh, verbose=self.verbose, permute=True)
+        self._batch_idx = idx_batched  # introspection/tests
 
         def loss_parts(tr, X_b, u_b, cw_b):
             if fused_res is not None:

@@ -73,7 +73,8 @@ def opt_state_matches(opt, trainables, opt_state) -> bool:
                                jax.tree_util.tree_leaves(want)))
 
 
-def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True):
+def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
+                 permute: bool = False):
     """Slice the collocation set into scan-able batches.
 
     Returns ``(X_batched [n_b, bsz, d], idx_batched [n_b, bsz], n_batches)``
@@ -87,7 +88,15 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True):
     so each ``[bsz, d]`` batch is itself sharded over ``"data"``, the λ-row
     gather stays device-local, and no reshape ever crosses the sharded point
     axis.  Matches the reference's global-batch semantics
-    (``models.py:252-263``: global batch = per-replica × replicas)."""
+    (``models.py:252-263``: global batch = per-replica × replicas).
+
+    ``permute=True``: a fixed seeded shuffle of the row order before
+    batching — WITHIN each device's block under ``mesh``, so the λ gather
+    stays device-local.  Required for ORDERED point sets (meshgrid
+    observation grids): a contiguous batch there is a thin coordinate slab,
+    measured to destabilise inverse-problem coefficients (spatially biased
+    gradients).  LHS collocation draws are already unordered, so the
+    forward solver keeps the default."""
     N_f = int(X_f.shape[0])
     if batch_sz is None or batch_sz >= N_f:
         n_batches, bsz = 1, N_f
@@ -115,7 +124,12 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True):
         shard_rows = N_f // n_dev
         bsz_local = bsz // n_dev
         n_batches = shard_rows // bsz_local
-        idx = np.arange(n_dev * shard_rows).reshape(n_dev, shard_rows)
+        if permute:
+            rs = np.random.RandomState(0)
+            idx = np.stack([rs.permutation(shard_rows) + d * shard_rows
+                            for d in range(n_dev)])
+        else:
+            idx = np.arange(n_dev * shard_rows).reshape(n_dev, shard_rows)
         idx = idx[:, : n_batches * bsz_local]
         idx = idx.reshape(n_dev, n_batches, bsz_local)
         idx = np.swapaxes(idx, 0, 1).reshape(n_batches, bsz)  # [n_b, bsz]
@@ -129,6 +143,11 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True):
             NamedSharding(mesh, P(None, DATA_AXIS, None)))
         idx_batched = jax.device_put(
             jnp.asarray(idx), NamedSharding(mesh, P(None, DATA_AXIS)))
+    elif permute and n_batches > 1:
+        perm = np.random.RandomState(0).permutation(N_f)[: n_batches * bsz]
+        X_batched = jnp.take(X_f, jnp.asarray(perm), axis=0).reshape(
+            n_batches, bsz, -1)
+        idx_batched = jnp.asarray(perm).reshape(n_batches, bsz)
     else:
         X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
         idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)

@@ -678,11 +678,13 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
     t_target = None
     t_prev = 0.0
     adam_done = 0
+    newton_done = 0
     windows = 1
     if ckpt and os.path.exists(os.path.join(ckpt, "tdq_meta.json")):
         try:
             solver.restore_checkpoint(ckpt)
             adam_done = min(len(solver.losses), adam_iter)
+            newton_done = min(getattr(solver, "newton_done", 0), newton_iter)
             try:
                 with open(meta_path) as fh:
                     m = json.load(fh)
@@ -693,7 +695,8 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
             except Exception:
                 pass  # solver state alone still saves the training time
             log(f"[full] resumed from {ckpt}: {adam_done} Adam epochs, "
-                f"{t_prev:.0f}s productive time, window #{windows}")
+                f"{newton_done} L-BFGS iters, {t_prev:.0f}s productive "
+                f"time, window #{windows}")
         except Exception as e:
             log(f"[full] checkpoint in {ckpt} not restorable "
                 f"({type(e).__name__}: {e}); starting fresh")
@@ -734,7 +737,8 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
                      "engine": engine_used, "windows": windows,
                      "timeline": list(timeline)})
 
-    solver.fit(tf_iter=adam_iter - adam_done, newton_iter=newton_iter,
+    solver.fit(tf_iter=adam_iter - adam_done,
+               newton_iter=newton_iter - newton_done,
                eval_fn=eval_fn, eval_every=eval_every,
                checkpoint_dir=(ckpt or None), checkpoint_every=eval_every)
     wall = t_prev + time.time() - t0

@@ -86,3 +86,45 @@ def example_args(description: str, flags=(), **extra):
 
 def scaled(args, full: int, quick: int) -> int:
     return quick if args.quick else full
+
+
+def fit_resumable(solver, tf_iter: int, newton_iter: int = 0,
+                  quick: bool = False, **fit_kw):
+    """``solver.fit`` with optional cross-run resume.
+
+    When ``TDQ_CKPT`` names a directory, training state checkpoints there
+    at chunk boundaries (``fit(checkpoint_dir=)``) and a rerun of the same
+    example picks up where a killed run stopped — the watcher's full-size
+    TPU runs live behind an intermittent tunnel, and an 85-minute config
+    that dies at minute 80 must not restart from zero on the next window.
+    Both phases are credited on resume: Adam epochs ride in the restored
+    loss history, completed L-BFGS iterations in the checkpoint's
+    ``newton_done``.  A COMPLETED run removes the checkpoint, so a later
+    deliberate re-measurement trains from scratch instead of silently
+    resuming a finished run.  Without ``TDQ_CKPT`` (or with
+    ``quick=True`` — pass ``args.quick``; a smoke run must never seed a
+    full run's resume point) this is exactly ``solver.fit``."""
+    import shutil
+
+    ck = None if quick else os.environ.get("TDQ_CKPT")
+    if not ck:
+        return solver.fit(tf_iter=tf_iter, newton_iter=newton_iter, **fit_kw)
+    done = n_done = 0
+    if os.path.exists(os.path.join(ck, "tdq_meta.json")) \
+            or os.path.exists(os.path.join(ck + ".old", "tdq_meta.json")):
+        try:
+            solver.restore_checkpoint(ck)
+            done = min(len(solver.losses), tf_iter)
+            n_done = min(getattr(solver, "newton_done", 0), newton_iter)
+            print(f"[tdq] resumed from {ck}: {done} Adam epochs, "
+                  f"{n_done} L-BFGS iters", flush=True)
+        except Exception as e:
+            print(f"[tdq] checkpoint in {ck} not restorable "
+                  f"({type(e).__name__}: {e}); starting fresh", flush=True)
+    out = solver.fit(tf_iter=tf_iter - done,
+                     newton_iter=newton_iter - n_done,
+                     checkpoint_dir=ck,
+                     checkpoint_every=max(200, tf_iter // 10), **fit_kw)
+    for d in (ck, ck + ".old", ck + ".tmp"):
+        shutil.rmtree(d, ignore_errors=True)
+    return out

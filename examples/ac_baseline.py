@@ -7,7 +7,7 @@ N_f=50k, 2-128x4-1 tanh MLP, 10k Adam + 10k L-BFGS.
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
@@ -60,7 +60,7 @@ def main():
     widths = [128] * 4 if not args.quick else [32] * 2
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
                newton_iter=scaled(args, 10_000, 100))
     return evaluate(solver, args, "ac_baseline")
 

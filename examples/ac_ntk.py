@@ -7,7 +7,7 @@ are recomputed from the tangent kernel every training chunk, covering all
 terms — including the periodic BC, which the SA path cannot weight.
 """
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 from ac_baseline import build_problem, evaluate
 
@@ -23,7 +23,7 @@ def main():
 
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs, Adaptive_type=3)
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
                newton_iter=scaled(args, 10_000, 100))
     lam = {k: [float(v) for v in vs] for k, vs in solver.lambdas.items()}
     print(f"final NTK weights: {lam}")

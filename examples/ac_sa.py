@@ -11,7 +11,7 @@ SURVEY §2.4.7; the working encoding is Adaptive_type=1.)
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 from ac_baseline import build_problem, evaluate
 
@@ -44,7 +44,7 @@ def main():
     solver.compile([2, *widths, 1], f_model, domain, bcs, Adaptive_type=1,
                    dict_adaptive=dict_adaptive, init_weights=init_weights,
                    network=network)
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
                newton_iter=scaled(args, 10_000, 100))
     err = evaluate(solver, args, "ac_sa")
     if args.plot:

@@ -11,7 +11,7 @@ epochs toward where |f| is large — the shock line here.
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
@@ -41,7 +41,7 @@ def main():
     widths = [20] * 8 if not args.quick else [20] * 4
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
-    solver.fit(tf_iter=scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
                newton_iter=scaled(args, 10_000, 100),
                resample_every=args.resample)
 

@@ -8,7 +8,7 @@ multi-variable periodic BC, and higher-derivative matching.
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
                               periodicBC)
@@ -53,7 +53,7 @@ def main():
     widths = [w] * (4 if not args.quick else 2)
     solver = CollocationSolverND()
     solver.compile([3, *widths, 1], f_model, domain, bcs)
-    solver.fit(tf_iter=args.adam or scaled(args, 1_000, 100),
+    fit_resumable(solver, quick=args.quick, tf_iter=args.adam or scaled(args, 1_000, 100),
                newton_iter=args.newton or scaled(args, 1_000, 50))
     print(f"final loss: {solver.losses[-1]['Total Loss']:.4e}")
     return solver

@@ -9,7 +9,7 @@ run validates relative L2 against it on a grid.
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, d,
@@ -54,7 +54,7 @@ def main():
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
     assert solver._fused_residual is not None, "3rd-order path should fuse"
-    solver.fit(tf_iter=args.adam or scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=args.adam or scaled(args, 10_000, 200),
                newton_iter=args.newton or scaled(args, 10_000, 100))
 
     x = domain.linspace("x")

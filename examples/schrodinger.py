@@ -11,7 +11,7 @@ Fourier spectral solution in ``tensordiffeq_tpu.exact``.
 
 import numpy as np
 
-from _common import example_args, scaled
+from _common import example_args, scaled, fit_resumable
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
@@ -80,7 +80,7 @@ def main():
 
     solver = CollocationSolverND()
     solver.compile([2, *widths, 2], f_model, domain, bcs)
-    solver.fit(tf_iter=args.adam or scaled(args, 10_000, 200),
+    fit_resumable(solver, quick=args.quick, tf_iter=args.adam or scaled(args, 10_000, 200),
                newton_iter=args.newton or scaled(args, 10_000, 100))
     return evaluate(solver, args, "schrodinger")
 

@@ -16,6 +16,9 @@ mkdir -p runs
 # see tpu_evidence.sh: never burn the tunnel window on unpromotable
 # CPU fallbacks from the watcher
 export BENCH_NO_CPU_FALLBACK=1
+# every full-size run checkpoints mid-run (fit_resumable/TDQ_CKPT):
+# a tunnel death at minute 80 of an 85-minute config resumes on the
+# next watcher pass instead of restarting from zero
 
 healthy() {
     # resolve_backend cache lives in tempfile.gettempdir() (honours TMPDIR,
@@ -34,14 +37,14 @@ done_marker() {  # done_marker <file> <pattern>
 echo "=== A. Allen-Cahn baseline (N_f=50k, 10k Adam + 10k L-BFGS) ==="
 if done_marker runs/ac_baseline_full_tpu.log "Error u"; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/ac_baseline.py > runs/ac_baseline_full_tpu.log 2>&1
+    TDQ_CKPT=runs/ck_ac_baseline timeout 5400 python examples/ac_baseline.py > runs/ac_baseline_full_tpu.log 2>&1
     grep -a "Error u" runs/ac_baseline_full_tpu.log || tail -3 runs/ac_baseline_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== B. Burgers forward (N_f=10k, 10k Adam + 10k L-BFGS) ==="
 if done_marker runs/burgers_full_tpu.log "Error u"; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/burgers.py > runs/burgers_full_tpu.log 2>&1
+    TDQ_CKPT=runs/ck_burgers timeout 5400 python examples/burgers.py > runs/burgers_full_tpu.log 2>&1
     grep -a "Error u" runs/burgers_full_tpu.log || tail -3 runs/burgers_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
@@ -96,7 +99,7 @@ echo "=== E. KdV soliton (N_f=20k, third-order fused engine, 10k+10k) ==="
 # watcher pass)
 if done_marker runs/kdv_full_tpu.log "relative L2"; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/kdv.py > runs/kdv_full_tpu.log 2>&1
+    TDQ_CKPT=runs/ck_kdv timeout 5400 python examples/kdv.py > runs/kdv_full_tpu.log 2>&1
     grep -a "relative L2" runs/kdv_full_tpu.log || tail -3 runs/kdv_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
@@ -106,7 +109,7 @@ echo "=== F. 2D Burgers (N_f=20k 3-D domain, 1k+1k) ==="
 # matched (round-3 audit)
 if done_marker runs/burgers2d_full_tpu.log "final loss"; then echo "done already"
 elif healthy; then
-    timeout 3600 python examples/burgers2d.py > runs/burgers2d_full_tpu.log 2>&1
+    TDQ_CKPT=runs/ck_burgers2d timeout 3600 python examples/burgers2d.py > runs/burgers2d_full_tpu.log 2>&1
     grep -a "final loss" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
@@ -117,7 +120,7 @@ echo "=== H. AC-SA with the exactly-periodic embedding net (beyond-reference) ==
 # MLP-only fused path) — fine on-chip, hours on CPU, hence TPU-gated.
 if done_marker runs/ac_sa_periodic_tpu.log "Error u"; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/ac_sa.py --periodic-net \
+    TDQ_CKPT=runs/ck_ac_sa_periodic timeout 5400 python examples/ac_sa.py --periodic-net \
         > runs/ac_sa_periodic_tpu.log 2>&1
     grep -a "Error u" runs/ac_sa_periodic_tpu.log || tail -3 runs/ac_sa_periodic_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
@@ -125,7 +128,7 @@ else echo "SKIP: tunnel unhealthy"; fi
 echo "=== I. Nonlinear Schrödinger (2-output system, N_f=20k, 10k+10k) ==="
 if done_marker runs/schrodinger_full_tpu.log "Error u"; then echo "done already"
 elif healthy; then
-    timeout 5400 python examples/schrodinger.py > runs/schrodinger_full_tpu.log 2>&1
+    TDQ_CKPT=runs/ck_schrodinger timeout 5400 python examples/schrodinger.py > runs/schrodinger_full_tpu.log 2>&1
     grep -a "Error u" runs/schrodinger_full_tpu.log || tail -3 runs/schrodinger_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 

@@ -714,11 +714,15 @@ class CollocationSolverND:
                     self._X_f_host = host
                 return X_new
 
+        # L-BFGS iterations completed BEFORE this fit call (nonzero only
+        # after a checkpoint restore) — checkpoint metadata records
+        # absolute refinement progress so a third window resumes correctly
+        newton_prior = int(getattr(self, "newton_done", 0))
         ckpt_hook = None
         if checkpoint_dir is not None and checkpoint_every > 0:
             from ..checkpoint import save_checkpoint as _save_ck
 
-            def ckpt_hook(trainables, opt_state, epoch):
+            def ckpt_hook(trainables, opt_state, epoch, newton_done=0):
                 # write directly from the LIVE buffers (solver attributes
                 # only re-sync after the phase; the run's donated buffers
                 # are valid exactly now, at this chunk boundary).  Each
@@ -736,6 +740,11 @@ class CollocationSolverND:
                           "min_loss": {k: float(v)
                                        for k, v in self.min_loss.items()},
                           "best_epoch": dict(self.best_epoch),
+                          # L-BFGS iterations completed at save time, so a
+                          # resume can credit the refinement phase too
+                          # (the loss history counts only Adam epochs
+                          # until the phase returns)
+                          "newton_done": int(newton_done),
                           "has_opt_state": opt_state is not None})
 
         result = FitResult()
@@ -809,7 +818,8 @@ class CollocationSolverND:
                     # params advance; λ and Adam moments ride unchanged, so
                     # a resume re-enters L-BFGS from the latest iterate
                     ckpt_hook({"params": p, "lambdas": self.lambdas},
-                              self.opt_state, i)
+                              self.opt_state, i,
+                              newton_done=newton_prior + i)
                 if eval_fn is not None and eval_every > 0 \
                         and prev // eval_every != i // eval_every:
                     eval_fn("l-bfgs", i, p)
@@ -825,9 +835,17 @@ class CollocationSolverND:
             self.best_model["l-bfgs"] = best_params
             self.min_loss["l-bfgs"] = float(best_loss)
             self.best_epoch["l-bfgs"] = int(best_iter)
+            self.newton_done = newton_prior + newton_iter
 
-        # overall best selection (reference fit.py:95-102)
-        if self.min_loss["adam"] <= self.min_loss["l-bfgs"]:
+        # overall best selection (reference fit.py:95-102).  A phase whose
+        # snapshot is None (skipped this call — e.g. a checkpoint-resumed
+        # fit that re-enters straight into L-BFGS) can carry a restored
+        # min_loss but must never win: picking a None model would silently
+        # degrade predict(best_model=True) to the final iterate.
+        adam_ok = self.best_model["adam"] is not None
+        lbfgs_ok = self.best_model["l-bfgs"] is not None
+        if adam_ok and (not lbfgs_ok
+                        or self.min_loss["adam"] <= self.min_loss["l-bfgs"]):
             which, offset = "adam", 0
         else:
             which, offset = "l-bfgs", tf_iter
@@ -874,6 +892,7 @@ class CollocationSolverND:
         meta = {"losses": self.losses,
                 "min_loss": {k: float(v) for k, v in self.min_loss.items()},
                 "best_epoch": dict(self.best_epoch),
+                "newton_done": int(getattr(self, "newton_done", 0)),
                 "has_opt_state": self.opt_state is not None}
         save_checkpoint(path, state, meta)
 
@@ -926,6 +945,10 @@ class CollocationSolverND:
             self.min_loss[k] = float(v)
         for k, v in meta.get("best_epoch", {}).items():
             self.best_epoch[k] = int(v)
+        # L-BFGS iterations already completed when this checkpoint was
+        # taken (0 for Adam-phase checkpoints) — resume helpers subtract
+        # it from the refinement budget
+        self.newton_done = int(meta.get("newton_done", 0))
         return self
 
     # ------------------------------------------------------------------ #

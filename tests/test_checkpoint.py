@@ -264,3 +264,23 @@ def test_midrun_checkpoint_resume_matches_uninterrupted(tmp_path):
     # λ kept ascending through the resume (SA state survived)
     assert not np.allclose(np.asarray(b.lambdas["residual"][0]),
                            np.asarray(a.lambdas["residual"][0]))
+
+
+def test_midrun_checkpoint_credits_lbfgs_progress(tmp_path):
+    """Mid-L-BFGS checkpoints record ABSOLUTE refinement progress
+    (newton_done), so a resume can subtract it from the budget instead of
+    re-running the whole phase — across multiple kill/resume windows."""
+    ck = str(tmp_path / "nck")
+    a = make_solver()
+    a.fit(tf_iter=30, chunk=15, newton_iter=60,
+          checkpoint_dir=ck, checkpoint_every=30)
+    assert a.newton_done == 60
+
+    b = make_solver()
+    b.restore_checkpoint(ck)
+    assert b.newton_done == 60          # absolute, from the checkpoint
+    b.fit(tf_iter=0, newton_iter=40,    # a further window
+          checkpoint_dir=ck, checkpoint_every=20)
+    assert b.newton_done == 100
+    # the skipped Adam phase must not poison best-model selection
+    assert b.best_model["overall"] is not None

@@ -229,3 +229,27 @@ def test_dist_minibatch_loss_matches_manual_batches(eight_devices):
                            s3.lambdas["residual"], X_b[0])
     np.testing.assert_allclose(float(l_dist), float(l_manual), rtol=1e-6)
     assert np.isfinite(first_epoch_loss)
+
+
+def test_dist_composes_with_remat(eight_devices):
+    """remat (backward-pass rematerialization) must compose with the
+    sharded data-parallel path: same mesh semantics, loss still trains,
+    and the rematerialized loss matches the plain one at init."""
+    mesh = make_mesh()
+    a = make_problem()
+    domain = a.domain
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        u_xx = grad(u_x, "x")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
+
+    b = CollocationSolverND(verbose=False)
+    b.compile([2, 8, 8, 1], f_model, domain, a.bcs, dist=True, remat=True)
+    la, _ = a.update_loss()
+    lb, _ = b.update_loss()
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    b.fit(tf_iter=40, newton_iter=0, chunk=20)
+    l1, _ = b.update_loss()
+    assert float(l1) < float(lb)
+    assert b.X_f.sharding.is_equivalent_to(data_sharding(mesh, 2), ndim=2)

@@ -1,0 +1,92 @@
+"""NTK weighting vs control on Helmholtz — the feature's home turf.
+
+The AC arm of the round-4 ablation showed NTK per-TERM balancing cannot
+fix Allen-Cahn (control 5.89e-1 vs ntk 6.02e-1 at equal budget): AC's
+failure mode is per-POINT stiffness, which only the SA minimax targets
+(12.5x gap, CONVERGENCE.md).  NTK's own claim (Wang et al. 2007.14527)
+is about balancing loss-term SCALES on smooth boundary-value problems —
+Helmholtz with a high-frequency forcing is the canonical case: the BC
+terms and the (much larger) residual term live at very different scales.
+Two arms, identical config/seed/budget, rel-L2 vs the analytic solution.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           python scripts/cpu_ntk_helmholtz.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+OUT = os.path.join(ROOT, "runs", "ntk_helmholtz.json")
+N_F, ADAM, NEWTON = 8_192, 5_000, 2_000
+A1, A2, KSQ = 1.0, 4.0, 1.0
+
+
+def run_arm(ntk: bool):
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, dirichletBC, \
+        grad
+
+    domain = DomainND(["x", "y"])
+    domain.add("x", [-1.0, 1.0], 501)
+    domain.add("y", [-1.0, 1.0], 501)
+    domain.generate_collocation_points(N_F, seed=0)
+    bcs = [dirichletBC(domain, val=0.0, var=v, target=tg)
+           for v in ("x", "y") for tg in ("upper", "lower")]
+
+    def f_model(u, x, y):
+        import jax.numpy as jnp
+        pi = np.pi
+        s = jnp.sin(A1 * pi * x) * jnp.sin(A2 * pi * y)
+        forcing = (-(A1 * pi) ** 2 - (A2 * pi) ** 2 + KSQ) * s
+        return (grad(grad(u, "x"), "x")(x, y)
+                + grad(grad(u, "y"), "y")(x, y) + KSQ * u(x, y) - forcing)
+
+    solver = CollocationSolverND(verbose=False)
+    solver.compile([2, 32, 32, 32, 1], f_model, domain, bcs,
+                   **(dict(Adaptive_type=3) if ntk else {}))
+    t0 = time.time()
+    solver.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    n = 201
+    xv, yv = np.meshgrid(np.linspace(-1, 1, n), np.linspace(-1, 1, n))
+    exact = np.sin(A1 * np.pi * xv) * np.sin(A2 * np.pi * yv)
+    Xg = np.hstack([xv.reshape(-1, 1), yv.reshape(-1, 1)])
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    l2 = float(tdq.find_L2_error(u_pred, exact.reshape(-1, 1)))
+    return {"arm": "ntk" if ntk else "control", "rel_l2": l2,
+            "wall_s": round(wall, 1),
+            "config": f"Helmholtz N_f={N_F}, 2-32x3-1, {ADAM}+{NEWTON}"}
+
+
+def main():
+    results = {}
+    for name, flag in (("control", False), ("ntk", True)):
+        part = os.path.join(ROOT, "runs", f"ntk_helm_{name}.json")
+        if os.path.exists(part):
+            with open(part) as fh:
+                results[name] = json.load(fh)
+        else:
+            print(f"[{name}] running...", flush=True)
+            results[name] = run_arm(flag)
+            with open(part, "w") as fh:
+                json.dump(results[name], fh)
+        print(f"[{name}] rel-L2={results[name]['rel_l2']:.3e}", flush=True)
+    out = {"arms": results,
+           "ntk_gain_vs_control":
+               round(results["control"]["rel_l2"]
+                     / results["ntk"]["rel_l2"], 3)}
+    with open(OUT, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "arms"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

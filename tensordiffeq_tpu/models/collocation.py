@@ -73,7 +73,7 @@ class CollocationSolverND:
                 network=None, lr: float = 0.005, lr_weights: float = 0.005,
                 fused: Optional[bool] = None, fused_dtype=None,
                 causal_eps: Optional[float] = None, causal_bins: int = 32,
-                remat: bool = False):
+                remat: bool = False, ntk_max_ratio: Optional[float] = 100.0):
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
@@ -113,6 +113,12 @@ class CollocationSolverND:
             to the Adam phase only: L-BFGS line searches break down on
             bf16 gradient noise, so the Newton refinement phase always
             runs a full-precision engine.
+          ntk_max_ratio: bound on the NTK weights' dynamic range
+            (``Adaptive_type=3`` only): λ are clipped to ``ntk_max_ratio ×
+            min(λ)``.  Default 100 — the raw paper formula was measured to
+            under-weight a large-trace residual term ~4500× on Helmholtz,
+            starving the PDE out of the gradient entirely (see
+            ``ops/ntk.py``); ``None`` restores the unbounded formula.
           remat: rematerialize the residual chain in the backward pass
             (``jax.checkpoint`` — see :func:`..models.assembly.
             build_loss_fn`): ~chain-multiplicity lower peak memory for one
@@ -156,6 +162,7 @@ class CollocationSolverND:
         self.causal_eps = causal_eps
         self.causal_bins = causal_bins
         self.remat = remat
+        self.ntk_max_ratio = ntk_max_ratio
         self._causal_kw = {} if causal_eps is None else dict(
             causal_eps=causal_eps, causal_bins=causal_bins,
             time_index=domain.vars.index(domain.time_var),
@@ -554,7 +561,8 @@ class CollocationSolverND:
                 self.bcs, self.X_f, n_residuals=n_res,
                 data_X=self.data_X, data_s=self.data_s)
             self._ntk_fn = make_ntk_weight_fn(bc_fns, res_all_fn, n_res,
-                                              data_fn=data_fn)
+                                              data_fn=data_fn,
+                                              max_ratio=self.ntk_max_ratio)
             if data_fn is not None and "data" not in self.lambdas:
                 self.lambdas["data"] = [jnp.ones((), jnp.float32)]
 

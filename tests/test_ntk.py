@@ -54,7 +54,7 @@ def test_weight_formula():
                                rtol=1e-5)
 
 
-def make_ac(n_f=256, nx=32, Adaptive_type=3):
+def make_ac(n_f=256, nx=32, Adaptive_type=3, **compile_kw):
     domain = DomainND(["x", "t"], time_var="t")
     domain.add("x", [-1.0, 1.0], nx)
     domain.add("t", [0.0, 1.0], 8)
@@ -73,7 +73,7 @@ def make_ac(n_f=256, nx=32, Adaptive_type=3):
 
     s = CollocationSolverND(verbose=False)
     s.compile([2, 8, 8, 1], f_model, domain, bcs,
-              Adaptive_type=Adaptive_type)
+              Adaptive_type=Adaptive_type, **compile_kw)
     return s
 
 
@@ -96,10 +96,11 @@ def test_ntk_training_updates_weights_and_learns():
 
 
 def test_ntk_weights_balance_traces():
-    # after an update, lam_i * tr_i is the same for every term (= sum of
-    # traces) — verify via the error fns the solver itself built
+    # with the unbounded formula (ntk_max_ratio=None), lam_i * tr_i is the
+    # same for every term (= sum of traces) — verify via the error fns the
+    # solver itself built
     from tensordiffeq_tpu.ops.ntk import build_error_fns
-    s = make_ac()
+    s = make_ac(ntk_max_ratio=None)
     bc_fns, res_all_fn, _ = build_error_fns(
         s.apply_fn, s.domain.vars, s.n_out, s.f_model, s.bcs, s.X_f,
         n_residuals=1)
@@ -108,6 +109,35 @@ def test_ntk_weights_balance_traces():
     lams = [sc(v) for v in lam["BCs"] + lam["residual"]]
     products = [l * t for l, t in zip(lams, traces)]
     np.testing.assert_allclose(products, sum(traces), rtol=1e-3)
+
+
+def test_ntk_max_ratio_bounds_dynamic_range():
+    """The default cap (measured necessity: uncapped weights starved the
+    Helmholtz residual 4500x and the network fit u=0) must bound
+    max(lam)/min(lam) while preserving the balancing direction."""
+    from tensordiffeq_tpu.ops.ntk import build_error_fns
+    s_unb = make_ac(ntk_max_ratio=None)
+    s_cap = make_ac(ntk_max_ratio=100.0)
+    lam_u = s_unb._ntk_fn(s_unb.params)
+    lam_c = s_cap._ntk_fn(s_cap.params)
+    vals_u = [sc(v) for v in lam_u["BCs"] + lam_u["residual"]]
+    vals_c = [sc(v) for v in lam_c["BCs"] + lam_c["residual"]]
+    assert max(vals_u) / min(vals_u) > 100  # this config DOES trip the cap
+    assert max(vals_c) / min(vals_c) <= 100 * (1 + 1e-6)
+    # uncapped terms keep the exact paper weights AND their relative order
+    # (capped terms are bit-identical ties, so ordering among them is
+    # sort-implementation noise — exclude them from the order check)
+    m = min(vals_c)
+    unc = [(u, c) for u, c in zip(vals_u, vals_c)
+           if c < 100 * m * (1 - 1e-6)]
+    for u, c in unc:
+        np.testing.assert_allclose(c, u, rtol=1e-5)
+    unc_u = [u for u, _ in unc]
+    unc_c = [c for _, c in unc]
+    assert np.argsort(unc_u).tolist() == np.argsort(unc_c).tolist()
+    # every capped term's uncapped weight exceeds every uncapped term's
+    assert min(u for u, c in zip(vals_u, vals_c)
+               if c >= 100 * m * (1 - 1e-6)) >= max(unc_u)
 
 
 def test_ntk_weights_assimilation_data_term():

@@ -50,10 +50,12 @@ G_NAME = os.environ.get("DISC_G", "")
 # 8-step sweep (DiscoveryModel.fit(batch_sz=...), round-4 capability).
 TSUB = int(os.environ.get("DISC_TSUB", 8))
 BATCH = int(os.environ.get("DISC_BATCH", 0))
+SEED = int(os.environ.get("DISC_SEED", 0))  # network-init seed (robustness)
 LEG = 3_000
 # keep every variant's artifacts apart
 _SUF = ("" if SA else "_nosa") + (f"_{G_NAME}" if G_NAME else "") \
-    + (f"_t{TSUB}" if TSUB != 8 else "") + (f"_b{BATCH}" if BATCH else "")
+    + (f"_t{TSUB}" if TSUB != 8 else "") + (f"_b{BATCH}" if BATCH else "") \
+    + (f"_s{SEED}" if SEED else "")
 # the ckpt dir additionally carries a config token (full-x grid + per-var
 # lr labels): a leftover checkpoint from an older grid/optimizer layout
 # must never be restored into this one (ADVICE r3) — and restore is
@@ -98,7 +100,7 @@ def main():
     model.compile([2, 64, 64, 64, 64, 1], f_model,
                   [X[:, 0:1], X[:, 1:2]], u_star, var=[0.0, 0.0],
                   col_weights=rng.rand(X.shape[0], 1) if SA else None,
-                  varnames=["x", "t"], g=g,
+                  varnames=["x", "t"], g=g, seed=SEED,
                   lr_vars=[2e-5, 0.01], verbose=False)
 
     done = 0

@@ -716,7 +716,9 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
         u_pred = np.asarray(solver._apply_jit(params, Xg_j))
         l2 = float(find_L2_error(u_pred, u_star))
         t = t_prev + time.time() - t0
-        abs_step = step + (adam_done if phase == "adam" else 0)
+        # offset by the prior windows' progress in EACH phase so resumed
+        # timelines never repeat a label for different absolute iterations
+        abs_step = step + (adam_done if phase == "adam" else newton_done)
         timeline.append({"t": round(t, 1), "phase": f"{phase}@{abs_step}",
                          "l2": l2})
         if t_target is None and l2 <= target:
@@ -969,6 +971,38 @@ def save_tpu_cache(mode_flags, payload):
         log(f"[supervisor] cache write failed: {e}")
 
 
+def cache_age_days(payload):
+    """Days since the cached payload's on-hardware capture date, or None."""
+    cap = payload.get("captured")
+    if not cap:
+        return None
+    try:
+        then = time.mktime(time.strptime(cap, "%Y-%m-%d"))
+    except ValueError:
+        return None
+    return round((time.time() - then) / 86400, 1)
+
+
+def probe_failure_streak():
+    """Consecutive failed tunnel probes ending at the most recent one, from
+    the watcher's probe-by-probe record (runs/tunnel_history.log) — together
+    with ``cache_age_days`` this tells the driver at a glance how stale a
+    cached hardware number is and how long the tunnel has been dark."""
+    path = os.path.join(REPO, "runs", "tunnel_history.log")
+    try:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    n = 0
+    for ln in reversed(lines):
+        if "unhealthy" in ln:
+            n += 1
+        else:
+            break
+    return n
+
+
 def cpu_sanity(timeout):
     """Fresh small CPU measurement (BENCH_FAST config) to attach alongside a
     cached hardware payload — proves the code still runs end-to-end today
@@ -1096,6 +1130,15 @@ def main():
 
     cached = load_cached_tpu(mode_flags)
     if cached is not None:
+        age = cache_age_days(cached)
+        streak = probe_failure_streak()
+        cached["cache_age_days"] = age
+        cached["failed_probe_streak"] = streak
+        diag.append(
+            ("cache age unknown (no capture date)" if age is None
+             else f"cache age {age} days")
+            + ("; no watcher probe record" if streak is None
+               else f"; {streak} consecutive failed tunnel probes"))
         cached["diag"] = diag
         if remaining() > 240 and not no_cpu:
             cached["cpu_sanity"] = cpu_sanity(remaining() - 30)

@@ -1,0 +1,76 @@
+"""CPU hedge: FULL-size Allen-Cahn SA-PINN — the flagship config.
+
+The reference's headline example (``/root/reference/examples/AC-SA.py:12,
+55-56,64``): N_f=50,000 collocation points, 2-128x4-1 tanh MLP, per-point
+lambda_res ~ U[0,1], lambda_IC ~ 100*U[0,1], 10k Adam + 10k L-BFGS.  This
+config has never run to convergence on ANY backend here (VERDICT r4,
+Missing #4) — the TPU queue has it as step 1, but the tunnel decides when
+that happens.  This script is the tunnel-independent path: it drives the
+SAME machinery the TPU run uses (``bench.bench_time_to_l2`` — crash-safe
+mid-run checkpoints every eval, cumulative productive-time timeline,
+resume-on-restart) on the one CPU core, nice'd so interactive work wins.
+
+At CPU rates a straight 10k+10k run spans multiple sessions; each
+invocation extends the same checkpoint (``runs/ac_sa_full_cpu_ckpt`` —
+deliberately NOT the TPU queue's ``runs/full_ckpt``, so CPU productive
+time never contaminates an on-chip timeline) and streams the partial
+rel-L2 timeline to ``runs/ac_sa_full_cpu.json`` after every eval.
+
+Usage (see scripts/cpu_evidence_r5.sh):
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      nice -n 19 python scripts/cpu_ac_sa_full.py
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Own checkpoint namespace; never collide with the TPU queue's run.
+os.environ.setdefault("BENCH_FULL_CKPT",
+                      os.path.join(REPO, "runs", "ac_sa_full_cpu_ckpt"))
+
+N_F, NX, NT = 50_000, 512, 201
+WIDTHS = [128, 128, 128, 128]
+ADAM, NEWTON = 10_000, 10_000
+EVAL_EVERY = 50  # ~20 min of epochs per checkpoint at 1-core rates
+
+OUT = os.path.join(REPO, "runs", "ac_sa_full_cpu.json")
+
+
+def main():
+    import bench
+
+    def on_eval(snap):
+        payload = {
+            "run": "AC-SA full (flagship config, CPU hedge)",
+            "config": f"N_f={N_F}, 2-128x4-1, {ADAM}+{NEWTON}, "
+                      "lam_res U[0,1], lam_IC 100*U[0,1] "
+                      "(reference examples/AC-SA.py:12,55-56,64)",
+            "backend": "cpu-1core",
+            "status": "partial",
+            **snap,
+        }
+        with open(OUT + ".tmp", "w") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(OUT + ".tmp", OUT)
+
+    res = bench.bench_time_to_l2(
+        N_F, NX, NT, WIDTHS,
+        adam_iter=ADAM, newton_iter=NEWTON,
+        eval_every=EVAL_EVERY, on_eval=on_eval,
+        # autotune costs ~4x the compiles and its CPU pick for the AC-SA
+        # step config is the generic engine (BENCH_TPU_engines autotune
+        # history); pin it so the first checkpoint lands sooner
+        fused="generic")
+    res.update(run="AC-SA full (flagship config, CPU hedge)",
+               backend="cpu-1core", status="complete")
+    with open(OUT + ".tmp", "w") as fh:
+        json.dump(res, fh, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+    print(json.dumps({k: v for k, v in res.items() if k != "timeline"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -74,9 +74,12 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
     with open(os.path.join(tmp, _META), "w") as fh:
         json.dump({"backend": backend, "meta": meta or {}}, fh)
     # swap: park the previous checkpoint, promote the new one, then drop
-    # the parked copy.  Both renames are atomic on POSIX.
-    shutil.rmtree(old, ignore_errors=True)
+    # the parked copy.  Both renames are atomic on POSIX.  Only clear a
+    # stale ``.old`` when there is a current ``path`` to park in its place:
+    # if a prior save died mid-swap, ``.old`` holds the ONLY restorable
+    # checkpoint until the rename below promotes ``tmp``.
     if os.path.exists(path):
+        shutil.rmtree(old, ignore_errors=True)
         os.rename(path, old)
     os.rename(tmp, path)
     shutil.rmtree(old, ignore_errors=True)

@@ -730,7 +730,8 @@ class CollocationSolverND:
         if checkpoint_dir is not None and checkpoint_every > 0:
             from ..checkpoint import save_checkpoint as _save_ck
 
-            def ckpt_hook(trainables, opt_state, epoch, newton_done=0):
+            def ckpt_hook(trainables, opt_state, epoch, newton_done=0,
+                          best=None, phase="adam"):
                 # write directly from the LIVE buffers (solver attributes
                 # only re-sync after the phase; the run's donated buffers
                 # are valid exactly now, at this chunk boundary).  Each
@@ -743,17 +744,43 @@ class CollocationSolverND:
                          "lambdas": trainables["lambdas"]}
                 if opt_state is not None:
                     state["opt_state"] = opt_state
-                _save_ck(checkpoint_dir, state,
-                         {"losses": self.losses,
-                          "min_loss": {k: float(v)
-                                       for k, v in self.min_loss.items()},
-                          "best_epoch": dict(self.best_epoch),
-                          # L-BFGS iterations completed at save time, so a
-                          # resume can credit the refinement phase too
-                          # (the loss history counts only Adam epochs
-                          # until the phase returns)
-                          "newton_done": int(newton_done),
-                          "has_opt_state": opt_state is not None})
+                min_loss = {k: float(v) for k, v in self.min_loss.items()}
+                best_epoch = dict(self.best_epoch)
+                # best-model snapshot: solver attributes only sync after a
+                # phase returns, so collect every best iterate KNOWN at
+                # this boundary — the current phase's LIVE running best
+                # (threaded in by fit_adam / lbfgs_minimize) plus any
+                # already-synced or restored phase best — and save the
+                # winner's params, so a kill/resume keeps
+                # predict(best_model=True) honest across legs
+                cand = []
+                if best is not None and np.isfinite(float(best[1])):
+                    bl, bi = float(best[1]), int(best[2])
+                    cand.append((bl, bi, phase, best[0]))
+                    if bl < min_loss.get(phase, np.inf):
+                        min_loss[phase] = bl
+                        best_epoch[phase] = bi
+                for ph in ("adam", "l-bfgs"):
+                    bp = self.best_model.get(ph)
+                    if bp is not None and np.isfinite(
+                            float(self.min_loss.get(ph, np.inf))):
+                        cand.append((float(self.min_loss[ph]),
+                                     int(self.best_epoch[ph]), ph, bp))
+                meta = {"losses": self.losses,
+                        "min_loss": min_loss,
+                        "best_epoch": best_epoch,
+                        # L-BFGS iterations completed at save time, so a
+                        # resume can credit the refinement phase too
+                        # (the loss history counts only Adam epochs
+                        # until the phase returns)
+                        "newton_done": int(newton_done),
+                        "has_opt_state": opt_state is not None}
+                if cand:
+                    bl, bi, ph, bp = min(cand, key=lambda c: c[0])
+                    state["best_params"] = bp
+                    meta.update(has_best=True, best_phase=ph,
+                                best_loss=bl, best_iter=bi)
+                _save_ck(checkpoint_dir, state, meta)
 
         result = FitResult()
         result.losses = self.losses
@@ -798,9 +825,16 @@ class CollocationSolverND:
                 state_hook=ckpt_hook, state_hook_every=checkpoint_every)
             self.params = trainables["params"]
             self.lambdas = trainables["lambdas"]
-            self.best_model["adam"] = result.best_params["adam"]
-            self.min_loss["adam"] = result.min_loss["adam"]
-            self.best_epoch["adam"] = result.best_epoch["adam"]
+            # adopt the leg's best only if it beats a best restored from a
+            # checkpoint (a resumed leg must not clobber the pre-kill best
+            # iterate) — except under resampling, where losses from
+            # different point draws don't compare (same reset-on-redraw
+            # rule the in-run tracking applies)
+            if (self.best_model["adam"] is None or resample_fn is not None
+                    or result.min_loss["adam"] <= self.min_loss["adam"]):
+                self.best_model["adam"] = result.best_params["adam"]
+                self.min_loss["adam"] = result.min_loss["adam"]
+                self.best_epoch["adam"] = result.best_epoch["adam"]
 
         if newton_iter > 0:
             from ..training.lbfgs import fit_lbfgs
@@ -816,7 +850,7 @@ class CollocationSolverND:
                             if v > 0), default=0)
             lb_prev = {"i": 0}
 
-            def lb_callback(i, p):
+            def lb_callback(i, p, best=None):
                 prev, lb_prev["i"] = lb_prev["i"], i
                 # checkpoint BEFORE eval: the resume meta a caller writes
                 # from its eval hook must never describe state newer than
@@ -827,7 +861,14 @@ class CollocationSolverND:
                     # a resume re-enters L-BFGS from the latest iterate
                     ckpt_hook({"params": p, "lambdas": self.lambdas},
                               self.opt_state, i,
-                              newton_done=newton_prior + i)
+                              newton_done=newton_prior + i,
+                              # the live best counts iterations within THIS
+                              # leg; re-base to absolute so saved meta agrees
+                              # with the absolute newton_done beside it
+                              best=(None if best is None else
+                                    (best[0], best[1],
+                                     newton_prior + int(best[2]))),
+                              phase="l-bfgs")
                 if eval_fn is not None and eval_every > 0 \
                         and prev // eval_every != i // eval_every:
                     eval_fn("l-bfgs", i, p)
@@ -840,10 +881,18 @@ class CollocationSolverND:
                 callback_every=lb_every)
             self.params = params
             self.losses.extend(lbfgs_losses)
-            self.best_model["l-bfgs"] = best_params
-            self.min_loss["l-bfgs"] = float(best_loss)
-            self.best_epoch["l-bfgs"] = int(best_iter)
-            self.newton_done = newton_prior + newton_iter
+            # same adopt-if-better rule as the Adam phase: a resumed
+            # refinement leg keeps the restored best when that's better
+            if (self.best_model["l-bfgs"] is None
+                    or float(best_loss) <= float(self.min_loss["l-bfgs"])):
+                self.best_model["l-bfgs"] = best_params
+                self.min_loss["l-bfgs"] = float(best_loss)
+                # best_iter counts within this leg; record absolute
+                self.best_epoch["l-bfgs"] = newton_prior + int(best_iter)
+            # credit ACTUAL progress, not the requested budget: fit_lbfgs
+            # can stop early (NaN stop / tolerance break), and a resume
+            # must not skip refinement iterations that never ran
+            self.newton_done = newton_prior + len(lbfgs_losses)
 
         # overall best selection (reference fit.py:95-102).  A phase whose
         # snapshot is None (skipped this call — e.g. a checkpoint-resumed
@@ -902,6 +951,18 @@ class CollocationSolverND:
                 "best_epoch": dict(self.best_epoch),
                 "newton_done": int(getattr(self, "newton_done", 0)),
                 "has_opt_state": self.opt_state is not None}
+        # carry the best iterate too, so predict(best_model=True) survives
+        # a save/restore cycle (phase buckets tie-break before "overall",
+        # which always mirrors one of them — restores re-bucket by phase)
+        cand = [(float(self.min_loss.get(ph, np.inf)), ph)
+                for ph in ("adam", "l-bfgs", "overall")
+                if self.best_model.get(ph) is not None
+                and np.isfinite(float(self.min_loss.get(ph, np.inf)))]
+        if cand:
+            bl, ph = min(cand)
+            state["best_params"] = self.best_model[ph]
+            meta.update(has_best=True, best_phase=ph, best_loss=bl,
+                        best_iter=int(self.best_epoch.get(ph, -1)))
         save_checkpoint(path, state, meta)
 
     def restore_checkpoint(self, path: str):
@@ -932,12 +993,14 @@ class CollocationSolverND:
         from ..checkpoint import resolve_checkpoint_dir
         with open(_os.path.join(resolve_checkpoint_dir(path),
                                 "tdq_meta.json")) as fh:
-            has_opt = _json.load(fh)["meta"].get("has_opt_state", False)
-        if has_opt:
+            _meta_peek = _json.load(fh)["meta"]
+        if _meta_peek.get("has_opt_state", False):
             opt = make_optimizer(self.lr, self.lr_weights,
                                  freeze_lambdas=getattr(self, "use_ntk", False))
             template["opt_state"] = opt.init(
                 {"params": self.params, "lambdas": self.lambdas})
+        if _meta_peek.get("has_best", False):
+            template["best_params"] = self.params
         state, meta = restore_checkpoint(path, template)
         self.params = state["params"]
         self.lambdas = state["lambdas"]
@@ -953,6 +1016,16 @@ class CollocationSolverND:
             self.min_loss[k] = float(v)
         for k, v in meta.get("best_epoch", {}).items():
             self.best_epoch[k] = int(v)
+        if "best_params" in state:
+            # re-bucket the saved best iterate so a resumed fit's
+            # adopt-if-better rule competes against it, and mirror it into
+            # "overall" so predict(best_model=True) works immediately
+            ph = meta.get("best_phase", "adam")
+            if ph in ("adam", "l-bfgs"):
+                self.best_model[ph] = state["best_params"]
+            self.best_model["overall"] = state["best_params"]
+            self.min_loss["overall"] = float(meta.get("best_loss", np.inf))
+            self.best_epoch["overall"] = int(meta.get("best_iter", -1))
         # L-BFGS iterations already completed when this checkpoint was
         # taken (0 for Adam-phase checkpoints) — resume helpers subtract
         # it from the refinement budget

@@ -288,37 +288,23 @@ class DiscoveryModel:
         # rows; only those rows receive a gradient each step (out-of-batch
         # rows still drift on decayed Adam moments between their turns —
         # the same semantics as the forward solver's minibatch+SA path).
-        # Single device: ceil-batching with wraparound, so NO row is ever
-        # dropped (the tail batch wraps to the front of the set).  dist:
-        # make_batches' mesh-aware per-shard layout (device-multiple trim,
-        # as on the forward solver).
+        # Both layouts use make_batches' ceil-batching with wraparound, so
+        # NO row is ever dropped (the tail batch wraps), with permute=True:
+        # batches are PERMUTED subsets, not contiguous row blocks —
+        # observation grids come meshgrid-ordered (x-major), so a
+        # contiguous batch is a thin x-slab of the domain, measured on the
+        # 512x201 AC grid to destabilise the coefficients (spatially
+        # biased gradients oscillated c2 from 3.1 back to 1.6 over one
+        # leg).  The fixed seeded shuffle makes every batch domain-covering
+        # and deterministic, so batches replay identically across fit
+        # calls and checkpoint resumes (under dist the shuffle is within
+        # each device's block, keeping the λ gather device-local).
         mesh = None
         if self.dist:
             from ..parallel import make_mesh
             mesh = make_mesh()
-        N = int(X.shape[0])
-        if mesh is None and batch_sz and batch_sz < N:
-            n_batches = -(-N // int(batch_sz))  # ceil: keep every row
-            # batches are PERMUTED subsets, not contiguous row blocks:
-            # observation grids come meshgrid-ordered (x-major), so a
-            # contiguous batch is a thin x-slab of the domain — measured
-            # on the 512x201 AC grid to destabilise the coefficients
-            # (spatially biased gradients oscillated c2 from 3.1 back to
-            # 1.6 over one leg).  A fixed seeded shuffle makes every
-            # batch domain-covering; deterministic, so batches replay
-            # identically across fit calls and checkpoint resumes.
-            perm = np.random.RandomState(0).permutation(N)
-            idx = perm[np.arange(n_batches * int(batch_sz)) % N]
-            X_batched = jnp.take(X, jnp.asarray(idx), axis=0).reshape(
-                n_batches, int(batch_sz), -1)
-            idx_batched = jnp.asarray(idx).reshape(n_batches, int(batch_sz))
-        else:
-            # dist path: make_batches' mesh-aware layout with permute=True —
-            # observation grids are ordered, and contiguous per-shard
-            # blocks would be the same slab pathology (within-block
-            # shuffle keeps the λ gather device-local)
-            X_batched, idx_batched, n_batches = make_batches(
-                X, batch_sz, mesh=mesh, verbose=self.verbose, permute=True)
+        X_batched, idx_batched, n_batches = make_batches(
+            X, batch_sz, mesh=mesh, verbose=self.verbose, permute=True)
         self._batch_idx = idx_batched  # introspection/tests
 
         def loss_parts(tr, X_b, u_b, cw_b):
@@ -394,9 +380,12 @@ class DiscoveryModel:
         """Joint Adam training loop (reference ``models.py:381-398``).
 
         ``batch_sz`` (beyond-reference) minibatches the observation rows:
-        each step trains on one contiguous batch, rotating through the
-        set with a wraparound tail batch (every row trains every sweep;
-        under ``dist`` the set is instead trimmed to a device multiple).
+        each step trains on one fixed PERMUTED subset of rows (observation
+        grids are meshgrid-ordered, and contiguous slabs were measured to
+        destabilise the coefficients — see ``_build``), rotating through
+        the set with a wraparound tail batch so every row trains every
+        sweep (under ``dist`` the permutation is within each device's
+        block, keeping the λ gather local).
         Per-row SA ``col_weights`` ride with their rows — note that
         between a row's turns its λ still drifts on decayed Adam moments
         (standard sparse-gradient Adam; a bounded ``g=`` transform caps

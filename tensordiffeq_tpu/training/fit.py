@@ -81,14 +81,28 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
     where ``idx_batched`` maps each batch row back to its global point row
     (for gathering per-point SA λ).
 
-    Single device: contiguous reshape.  With ``mesh`` (data-parallel
-    training): **per-shard batching** — device k owns the contiguous row
-    block ``[k·N/n_dev, (k+1)·N/n_dev)`` of ``X_f`` and λ, and batch b takes
-    rows ``b·bszₗ:(b+1)·bszₗ`` of EVERY device's block (``bszₗ = bsz/n_dev``),
-    so each ``[bsz, d]`` batch is itself sharded over ``"data"``, the λ-row
-    gather stays device-local, and no reshape ever crosses the sharded point
-    axis.  Matches the reference's global-batch semantics
-    (``models.py:252-263``: global batch = per-replica × replicas).
+    **Every row trains**: batching is ceil-batching with wraparound — when
+    ``batch_sz`` does not divide the point count, the tail batch wraps to
+    the front of the set instead of dropping the remainder (the quiet data
+    loss the reference's loop had in a worse form, SURVEY §2.4.1, reference
+    ``fit.py:128-145``).  Wrapped rows simply get one extra gradient
+    contribution per epoch; with per-point SA λ the gather rides the same
+    index map, so λ rows wrap identically.  Under ``mesh`` the guarantee
+    is per-shard: the point count must already be a device multiple (the
+    data-parallel placement, :func:`..parallel.shard_data_inputs`, trims
+    to one up front with its own message) — a non-multiple passed here
+    directly leaves the last ``N_f % n_dev`` rows outside every shard
+    block, and is warned about.
+
+    Single device: contiguous reshape (+wraparound tail).  With ``mesh``
+    (data-parallel training): **per-shard batching** — device k owns the
+    contiguous row block ``[k·N/n_dev, (k+1)·N/n_dev)`` of ``X_f`` and λ,
+    and batch b takes rows ``b·bszₗ:(b+1)·bszₗ`` of EVERY device's block
+    (``bszₗ = bsz/n_dev``, wrapping within the block), so each ``[bsz, d]``
+    batch is itself sharded over ``"data"``, the λ-row gather stays
+    device-local, and no reshape ever crosses the sharded point axis.
+    Matches the reference's global-batch semantics (``models.py:252-263``:
+    global batch = per-replica × replicas).
 
     ``permute=True``: a fixed seeded shuffle of the row order before
     batching — WITHIN each device's block under ``mesh``, so the λ gather
@@ -101,20 +115,16 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
     if batch_sz is None or batch_sz >= N_f:
         n_batches, bsz = 1, N_f
     else:
-        n_batches = N_f // batch_sz
-        bsz = batch_sz
+        bsz = int(batch_sz)
         if mesh is not None:
             n_dev = int(np.prod(mesh.devices.shape))
             if bsz % n_dev:
                 orig = bsz
                 bsz = max(bsz - bsz % n_dev, n_dev)
-                n_batches = N_f // bsz
                 if verbose:
                     print(f"[fit] batch_sz {orig} -> {bsz} so each of "
                           f"the {n_dev} devices gets equal batch rows")
-        if verbose and n_batches * bsz != N_f:
-            print(f"[fit] dropping {N_f - n_batches * bsz} points so that "
-                  f"{bsz}-point batches tile the collocation set")
+        n_batches = -(-N_f // bsz)  # ceil: keep every row
 
     if mesh is not None and n_batches > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -122,15 +132,26 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
         from ..parallel import DATA_AXIS
         n_dev = int(np.prod(mesh.devices.shape))
         shard_rows = N_f // n_dev
+        if verbose and shard_rows * n_dev != N_f:
+            # normal dist flows never hit this (shard_data_inputs trims to
+            # a device multiple first); a direct caller should know
+            print(f"[fit] {N_f % n_dev} rows beyond the {n_dev}-device "
+                  f"multiple fall outside every shard block and never train")
         bsz_local = bsz // n_dev
-        n_batches = shard_rows // bsz_local
+        n_batches = -(-shard_rows // bsz_local)  # ceil: keep every row
         if permute:
             rs = np.random.RandomState(0)
-            idx = np.stack([rs.permutation(shard_rows) + d * shard_rows
-                            for d in range(n_dev)])
+            base = np.stack([rs.permutation(shard_rows)
+                             for _ in range(n_dev)])
         else:
-            idx = np.arange(n_dev * shard_rows).reshape(n_dev, shard_rows)
-        idx = idx[:, : n_batches * bsz_local]
+            base = np.tile(np.arange(shard_rows), (n_dev, 1))
+        # wraparound within each device's block: the tail batch reuses
+        # rows from the front of the SAME shard, keeping the gather local
+        take = np.arange(n_batches * bsz_local) % shard_rows
+        if verbose and take.size != shard_rows:
+            print(f"[fit] tail batch wraps {take.size - shard_rows} rows "
+                  f"per shard so {bsz}-point batches cover every point")
+        idx = base[:, take] + (np.arange(n_dev) * shard_rows)[:, None]
         idx = idx.reshape(n_dev, n_batches, bsz_local)
         idx = np.swapaxes(idx, 0, 1).reshape(n_batches, bsz)  # [n_b, bsz]
         # gather ON DEVICE (a host np.asarray round-trip would both move the
@@ -143,11 +164,18 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
             NamedSharding(mesh, P(None, DATA_AXIS, None)))
         idx_batched = jax.device_put(
             jnp.asarray(idx), NamedSharding(mesh, P(None, DATA_AXIS)))
-    elif permute and n_batches > 1:
-        perm = np.random.RandomState(0).permutation(N_f)[: n_batches * bsz]
-        X_batched = jnp.take(X_f, jnp.asarray(perm), axis=0).reshape(
+    elif n_batches > 1:
+        take = np.arange(n_batches * bsz) % N_f
+        if verbose and take.size != N_f:
+            print(f"[fit] tail batch wraps {take.size - N_f} rows so "
+                  f"{bsz}-point batches cover every point")
+        if permute:
+            idx = np.random.RandomState(0).permutation(N_f)[take]
+        else:
+            idx = take
+        X_batched = jnp.take(X_f, jnp.asarray(idx), axis=0).reshape(
             n_batches, bsz, -1)
-        idx_batched = jnp.asarray(perm).reshape(n_batches, bsz)
+        idx_batched = jnp.asarray(idx).reshape(n_batches, bsz)
     else:
         X_batched = X_f[: n_batches * bsz].reshape(n_batches, bsz, -1)
         idx_batched = jnp.arange(n_batches * bsz).reshape(n_batches, bsz)
@@ -276,12 +304,15 @@ def fit_adam(loss_fn: Callable,
     compiled runner and optimizer state carry straight on — only the batch
     buffers are rebuilt.
 
-    ``state_hook(trainables, opt_state, epoch)`` + ``state_hook_every``:
-    chunk-boundary access to the LIVE optimizer state (the solver object
-    only syncs after the phase returns) — the mid-run checkpoint path, so
-    a killed long run resumes instead of restarting.  Fires before
-    ``callback`` at the same boundary, so a checkpoint written here is
-    never newer than the evaluation recorded after it."""
+    ``state_hook(trainables, opt_state, epoch, best=...)`` +
+    ``state_hook_every``: chunk-boundary access to the LIVE optimizer
+    state (the solver object only syncs after the phase returns) — the
+    mid-run checkpoint path, so a killed long run resumes instead of
+    restarting.  ``best`` is the phase's live running best
+    ``(params_snapshot, best_loss, best_epoch)`` so checkpoints can carry
+    the best iterate, not just the final one.  Fires before ``callback``
+    at the same boundary, so a checkpoint written here is never newer
+    than the evaluation recorded after it."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
@@ -302,8 +333,8 @@ def fit_adam(loss_fn: Callable,
             "configuration?")
     else:
         opt_state = tree_copy(opt_state)
-    # classify per-point λ by the UNTRIMMED point count: λ keeps all N_f rows
-    # even when batches drop a remainder, and only gathered rows get gradients
+    # classify per-point λ by the full point count: λ keeps all N_f rows and
+    # batch rows gather from them (the wraparound tail re-gathers front rows)
     run = _chunk_runner(loss_fn, opt, n_batches, N_f)
 
     best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
@@ -347,7 +378,9 @@ def fit_adam(loss_fn: Callable,
         if (state_hook is not None and state_hook_every > 0
                 and prev_epochs // state_hook_every
                 != cur_epochs // state_hook_every):
-            state_hook(trainables, opt_state, cur_epochs)
+            state_hook(trainables, opt_state, cur_epochs,
+                       best=(best[0], best[1],
+                             int(best[2]) // max(n_batches, 1)))
         if (callback is not None and callback_every > 0
                 and prev_epochs // callback_every != cur_epochs // callback_every):
             callback(cur_epochs, trainables["params"])

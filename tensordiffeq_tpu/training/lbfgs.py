@@ -132,7 +132,9 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
         done += n
         if (callback is not None and callback_every > 0
                 and prev_done // callback_every != done // callback_every):
-            callback(done, x)
+            # the live running best rides along so mid-run checkpoints can
+            # carry the best iterate (not just the latest one)
+            callback(done, x, best)
         if pbar is not None:
             pbar.update(n)
             pbar.set_postfix(loss=float(values[-1]))

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 CPU evidence queue (sequential; each step idempotent via its
+# own runs/*.json guards).  Runs AFTER the annealed-causal ablation arm
+# that launched at round start; the AC-SA full hedge runs in parallel at
+# nice 19 the whole session.
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+# step 1: annealed-causal arm (skips arms already recorded)
+ABLATION_EXTRA=causal_anneal nice -n 15 python scripts/cpu_weighting_ablation.py \
+  >> runs/weighting_anneal.log 2>&1
+# step 2: NTK trace-subsample sensitivity (256/512/1024)
+nice -n 15 python scripts/cpu_ntk_helmholtz.py --sens \
+  >> runs/ntk_sensitivity.log 2>&1
+echo "r5 cpu evidence queue done $(date -u)" >> runs/cpu_evidence_r5.log

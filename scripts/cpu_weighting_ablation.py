@@ -63,6 +63,14 @@ def run_arm(name):
         # horizon earlier — the cheap stand-in for the paper's eps
         # annealing schedule
         kw = dict(causal_eps=0.02, causal_bins=32)
+    elif name == "causal_anneal":
+        # round 5: the REAL paper schedule (2203.07404 Alg. 1) — the full
+        # ladder, each stage advancing when the gate opens (w_last>0.99).
+        # Same seed/draw/budget as every other arm, so the r4 fixed-eps
+        # results (causal 6.52e-1, causal_lo 9.90e-1, control 5.89e-1)
+        # are directly comparable
+        kw = dict(causal_eps=[0.01, 0.1, 1.0, 10.0, 100.0],
+                  causal_bins=32)
 
     solver = CollocationSolverND(verbose=False)
     solver.compile([2, *WIDTHS, 1], f_model, domain, bcs, **kw)

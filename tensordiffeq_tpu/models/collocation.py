@@ -72,8 +72,10 @@ class CollocationSolverND:
                 g: Optional[Callable] = None, dist: bool = False,
                 network=None, lr: float = 0.005, lr_weights: float = 0.005,
                 fused: Optional[bool] = None, fused_dtype=None,
-                causal_eps: Optional[float] = None, causal_bins: int = 32,
-                remat: bool = False, ntk_max_ratio: Optional[float] = 100.0):
+                causal_eps=None, causal_bins: int = 32,
+                causal_delta: float = 0.99,
+                remat: bool = False, ntk_max_ratio: Optional[float] = 100.0,
+                ntk_max_points: int = 256):
         """Assemble the problem (reference ``models.py:27-105``).
 
         Args:
@@ -119,19 +121,36 @@ class CollocationSolverND:
             under-weight a large-trace residual term ~4500× on Helmholtz,
             starving the PDE out of the gradient entirely (see
             ``ops/ntk.py``); ``None`` restores the unbounded formula.
+          ntk_max_points: per-term trace subsample size for NTK weighting
+            (``Adaptive_type=3`` only; default 256).  The traces set only
+            the per-TERM balance, so a few hundred points estimate it
+            stably at ``O(max_points × params)`` jacobian cost — the
+            Helmholtz sensitivity runs at 512/1024 (CONVERGENCE.md,
+            round 5) measure exactly this.
           remat: rematerialize the residual chain in the backward pass
             (``jax.checkpoint`` — see :func:`..models.assembly.
             build_loss_fn`): ~chain-multiplicity lower peak memory for one
             extra forward of FLOPs, the standard HBM lever for pushing
             ``N_f`` per chip (beyond-reference; the reference splits large
             ``N_f`` across GPUs instead, ``AC-dist-new.py:14``).
-          causal_eps / causal_bins: temporal-causality weighting of the
-            residual (Wang et al. arXiv:2203.07404, beyond-reference) —
-            residual bin ``b`` along time is weighted
+          causal_eps / causal_bins / causal_delta: temporal-causality
+            weighting of the residual (Wang et al. arXiv:2203.07404,
+            beyond-reference) — residual bin ``b`` along time is weighted
             ``exp(-causal_eps * cumulative earlier-bin loss)``, so later
             times train only once earlier times are resolved.  Composes
             with SA λ; per-epoch ``Causal_w_last_j`` in the loss history
             reports completeness (→1 when the whole horizon trains).
+            A SEQUENCE of ε values enables the paper's annealing schedule
+            (Algorithm 1): Adam starts at the smallest ε and advances to
+            the next the moment the causal gate opens
+            (``Causal_w_last > causal_delta``, checked at chunk
+            boundaries), handing the remaining budget to the stricter
+            stage — a fixed ε was measured to either never open the gate
+            (large ε) or never enforce causality (small ε) at realistic
+            budgets (``runs/weighting_ablation.json``).  Each stage
+            re-jits once (the persistent compile cache absorbs repeats);
+            a checkpoint-resumed fit restarts the ladder and fast-forwards
+            through already-open stages at the first boundary check.
         """
         from ..utils import enable_compilation_cache
         enable_compilation_cache()  # warm process starts skip XLA compiles
@@ -159,12 +178,30 @@ class CollocationSolverND:
         self.g = g
         self.dist = dist
         self.fused = fused
-        self.causal_eps = causal_eps
+        # scalar -> single-stage ladder; sequence -> annealing schedule
+        # (kept sorted ascending: the paper advances small -> large ε)
+        if causal_eps is None:
+            self.causal_ladder = []
+        elif np.ndim(causal_eps) == 0:
+            self.causal_ladder = [float(causal_eps)]
+        else:
+            self.causal_ladder = sorted(float(e) for e in causal_eps)
+            if not self.causal_ladder:
+                raise ValueError("causal_eps sequence must be non-empty")
+        self.causal_eps = (self.causal_ladder[0]
+                           if self.causal_ladder else None)
         self.causal_bins = causal_bins
+        self.causal_delta = float(causal_delta)
         self.remat = remat
         self.ntk_max_ratio = ntk_max_ratio
-        self._causal_kw = {} if causal_eps is None else dict(
-            causal_eps=causal_eps, causal_bins=causal_bins,
+        # trace subsample size (per term) for NTK weighting: the traces
+        # drive only the per-TERM balance, so a few hundred points give a
+        # stable estimate at O(max_points x params) jacobian cost; the
+        # Helmholtz sensitivity runs (CONVERGENCE.md, round 5) measure the
+        # 256 default against 512/1024
+        self.ntk_max_points = int(ntk_max_points)
+        self._causal_kw = {} if self.causal_eps is None else dict(
+            causal_eps=self.causal_eps, causal_bins=causal_bins,
             time_index=domain.vars.index(domain.time_var),
             time_bounds=domain.bounds(domain.time_var))
         if fused_dtype is not None:
@@ -390,6 +427,34 @@ class CollocationSolverND:
             print(f"[autotune] residual engine: {best} ({shown})")
         return candidates[best]
 
+    def _assemble_losses(self):
+        """(Re)build ``loss_fn`` / ``loss_fn_refine`` from the selected
+        residual engines and the CURRENT ``_causal_kw`` — called by
+        ``compile`` and again by :meth:`_set_causal_eps` when the staged
+        ε ladder advances (new jit keys; the persistent compile cache
+        makes repeats warm)."""
+        self.loss_fn = build_loss_fn(
+            self.apply_fn, self.domain.vars, self.n_out, self.f_model,
+            self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
+            data_X=self.data_X, data_s=self.data_s,
+            residual_fn=self._fused_residual, remat=self.remat,
+            **self._causal_kw)
+        self.loss_fn_refine = self.loss_fn
+        if self._refine_residual is not self._fused_residual:
+            self.loss_fn_refine = build_loss_fn(
+                self.apply_fn, self.domain.vars, self.n_out, self.f_model,
+                self.bcs, weight_outside_sum=self.weight_outside_sum,
+                g=self.g, data_X=self.data_X, data_s=self.data_s,
+                residual_fn=self._refine_residual, remat=self.remat,
+                **self._causal_kw)
+
+    def _set_causal_eps(self, eps: float):
+        """Advance the causal-weighting tolerance (the annealing ladder,
+        Wang et al. 2203.07404 Alg. 1) and re-assemble the losses."""
+        self.causal_eps = float(eps)
+        self._causal_kw["causal_eps"] = float(eps)
+        self._assemble_losses()
+
     def _count_residuals(self) -> int:
         """Number of residual components ``f_model`` returns (trace once on
         a single point; multi-equation systems return a tuple)."""
@@ -516,28 +581,19 @@ class CollocationSolverND:
                 "fused_dtype was requested but no fused engine is active "
                 "(the residual fell back to the generic autodiff engine); "
                 "training runs full precision")
-        self.loss_fn = build_loss_fn(
-            self.apply_fn, self.domain.vars, self.n_out, self.f_model,
-            self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
-            data_X=self.data_X, data_s=self.data_s,
-            residual_fn=self._fused_residual, remat=self.remat,
-            **self._causal_kw)
-
-        # L-BFGS refinement loss: line searches break down on bf16 gradient
-        # noise (a second-order method amplifies ~5% derivative error into
-        # failed Wolfe conditions), so under fused_dtype the Newton phase
-        # gets a full-precision engine — bf16 Adam epochs, f32 refinement
-        self.loss_fn_refine = self.loss_fn
+        # L-BFGS refinement engine: line searches break down on bf16
+        # gradient noise (a second-order method amplifies ~5% derivative
+        # error into failed Wolfe conditions), so under fused_dtype the
+        # Newton phase gets a full-precision engine — bf16 Adam epochs,
+        # f32 refinement.  Stored so the staged causal-ε ladder can
+        # re-assemble both losses when ε advances.
+        self._refine_residual = self._fused_residual
         if self.fused_dtype is not None and self._fused_residual is not None:
             from ..ops.fused import make_fused_residual as _mfr
-            f32_res = _mfr(self.f_model, self.domain.vars, self.n_out,
-                           self._fuse_requests,
-                           precision=self.net.precision)
-            self.loss_fn_refine = build_loss_fn(
-                self.apply_fn, self.domain.vars, self.n_out, self.f_model,
-                self.bcs, weight_outside_sum=self.weight_outside_sum,
-                g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=f32_res, remat=self.remat, **self._causal_kw)
+            self._refine_residual = _mfr(
+                self.f_model, self.domain.vars, self.n_out,
+                self._fuse_requests, precision=self.net.precision)
+        self._assemble_losses()
 
         # jit-cached inference paths (params are traced args, so repeated
         # predict() calls reuse one compiled program)
@@ -559,6 +615,7 @@ class CollocationSolverND:
             bc_fns, res_all_fn, data_fn = build_error_fns(
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, self.X_f, n_residuals=n_res,
+                max_points=self.ntk_max_points,
                 data_X=self.data_X, data_s=self.data_s)
             self._ntk_fn = make_ntk_weight_fn(bc_fns, res_all_fn, n_res,
                                               data_fn=data_fn,
@@ -761,6 +818,12 @@ class CollocationSolverND:
                         min_loss[phase] = bl
                         best_epoch[phase] = bi
                 for ph in ("adam", "l-bfgs"):
+                    if (ph == phase == "adam"
+                            and getattr(self, "_ladder_active", False)):
+                        # mid-ladder: a stored Adam best carries another ε
+                        # stage's loss scale and does not compare with the
+                        # live best — the live (current-stage) one wins
+                        continue
                     bp = self.best_model.get(ph)
                     if bp is not None and np.isfinite(
                             float(self.min_loss.get(ph, np.inf))):
@@ -810,27 +873,95 @@ class CollocationSolverND:
                     src = getattr(self, "_X_f_host", None)
                     if src is None:  # pre-refactor pickles: device array
                         src = self.X_f
-                    return self._ntk_fn(p, residual_subsample(src))
-            trainables, self.opt_state, result = fit_adam(
-                self.loss_fn, self.params, lambdas, X_f,
-                tf_iter=tf_iter, batch_sz=batch_sz, lr=self.lr,
-                lr_weights=self.lr_weights, chunk=chunk,
-                verbose=self.verbose, result=result,
-                opt_state=self.opt_state, freeze_lambdas=freeze,
-                lambda_update_fn=ntk_update, mesh=mesh,
-                callback=(None if eval_fn is None else
-                          (lambda e, p: eval_fn("adam", e, p))),
-                callback_every=eval_every,
-                resample_fn=resample_fn, resample_every=resample_every,
-                state_hook=ckpt_hook, state_hook_every=checkpoint_every)
-            self.params = trainables["params"]
-            self.lambdas = trainables["lambdas"]
+                    return self._ntk_fn(
+                        p, residual_subsample(
+                            src, getattr(self, "ntk_max_points", 256)))
+            # staged causal-ε ladder (Wang et al. 2203.07404 Alg. 1): run
+            # Adam at each ε in ascending order, advancing the moment the
+            # causal gate opens (min Causal_w_last > causal_delta at a
+            # chunk boundary); the remaining epoch budget carries over,
+            # as do params / λ / Adam moments.  A single ε (or no causal
+            # mode) degenerates to one plain fit_adam call.
+            ladder = list(getattr(self, "causal_ladder", []) or [])
+            stages = ladder if len(ladder) > 1 else [None]
+            multi_stage = len(stages) > 1
+            self._ladder_active = multi_stage  # read by ckpt_hook
+            remaining = tf_iter
+            stage_off = 0  # epochs consumed by earlier stages THIS fit call
+            for si, eps in enumerate(stages):
+                if eps is not None and eps != self.causal_eps:
+                    if self.verbose:
+                        if si == 0:
+                            print(f"[causal] ladder restart: ε -> {eps:g}")
+                        else:
+                            print(f"[causal] gate open (w_last > "
+                                  f"{self.causal_delta:g}); ε -> {eps:g} "
+                                  f"({remaining} Adam epochs left)")
+                    self._set_causal_eps(eps)
+                stop_fn = None
+                if si < len(stages) - 1:
+                    def stop_fn(res, _d=self.causal_delta):
+                        last = res.losses[-1] if res.losses else {}
+                        w = [v for k, v in last.items()
+                             if k.startswith("Causal_w_last")]
+                        return bool(w) and min(w) > _d
+                epochs_before = len(result.losses)
+                wall_before = result.wall_time.get("adam", 0.0)
+                # re-base stage-relative epochs to run-relative in every
+                # host hook, so timelines / resume meta / pool draws stay
+                # monotonic across stages (the L-BFGS leg's newton_prior
+                # re-basing, one level up)
+                off = stage_off
+
+                def with_off(fn, _o=off):
+                    return None if fn is None else (
+                        lambda e, p: fn(e + _o, p))
+                res_fn = resample_fn
+                if resample_fn is not None and off:
+                    def res_fn(p, e, _o=off):  # (params, epoch) order
+                        return resample_fn(p, e + _o)
+                hook = ckpt_hook
+                if hook is not None and off:
+                    def hook(tr, st, e, best=None, _o=off, **kw):
+                        if best is not None:
+                            best = (best[0], best[1], int(best[2]) + _o)
+                        ckpt_hook(tr, st, e + _o, best=best, **kw)
+                trainables, self.opt_state, result = fit_adam(
+                    self.loss_fn, self.params, lambdas, X_f,
+                    tf_iter=remaining, batch_sz=batch_sz, lr=self.lr,
+                    lr_weights=self.lr_weights, chunk=chunk,
+                    verbose=self.verbose, result=result,
+                    opt_state=self.opt_state, freeze_lambdas=freeze,
+                    lambda_update_fn=ntk_update, mesh=mesh,
+                    callback=(None if eval_fn is None else
+                              with_off(lambda e, p: eval_fn("adam", e, p))),
+                    callback_every=eval_every,
+                    resample_fn=res_fn,
+                    resample_every=resample_every,
+                    state_hook=hook, state_hook_every=checkpoint_every,
+                    stop_fn=stop_fn)
+                self.params = trainables["params"]
+                self.lambdas = lambdas = trainables["lambdas"]
+                result.wall_time["adam"] += wall_before
+                stage_epochs = len(result.losses) - epochs_before
+                remaining -= stage_epochs
+                stage_off += stage_epochs
+                if remaining <= 0:
+                    break
+            if multi_stage and result.best_epoch["adam"] >= 0:
+                # stage losses are weighted by different ε and do not
+                # compare (the reset-on-redraw principle): the LAST —
+                # strictest — stage's best is the run's best, recorded at
+                # its run-relative epoch
+                result.best_epoch["adam"] += stage_off - stage_epochs
             # adopt the leg's best only if it beats a best restored from a
             # checkpoint (a resumed leg must not clobber the pre-kill best
-            # iterate) — except under resampling, where losses from
-            # different point draws don't compare (same reset-on-redraw
-            # rule the in-run tracking applies)
+            # iterate) — except under resampling or a multi-stage causal
+            # ladder, where losses from different point draws / ε stages
+            # don't compare (same reset-on-redraw rule the in-run tracking
+            # applies): there the current leg's final-stage best wins
             if (self.best_model["adam"] is None or resample_fn is not None
+                    or multi_stage
                     or result.min_loss["adam"] <= self.min_loss["adam"]):
                 self.best_model["adam"] = result.best_params["adam"]
                 self.min_loss["adam"] = result.min_loss["adam"]

@@ -283,6 +283,7 @@ def fit_adam(loss_fn: Callable,
              resample_every: int = 0,
              state_hook: Optional[Callable] = None,
              state_hook_every: int = 0,
+             stop_fn: Optional[Callable] = None,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -312,7 +313,12 @@ def fit_adam(loss_fn: Callable,
     ``(params_snapshot, best_loss, best_epoch)`` so checkpoints can carry
     the best iterate, not just the final one.  Fires before ``callback``
     at the same boundary, so a checkpoint written here is never newer
-    than the evaluation recorded after it."""
+    than the evaluation recorded after it.
+
+    ``stop_fn(result) -> bool``: checked at chunk boundaries; returning
+    True ends the phase early with the state as of that boundary (the
+    staged causal-ε ladder uses this to hand the remaining budget to the
+    next ε stage the moment the causal gate opens)."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
@@ -387,6 +393,8 @@ def fit_adam(loss_fn: Callable,
         if pbar is not None:
             pbar.update(n // n_batches)
             pbar.set_postfix(loss=result.losses[-1]["Total Loss"])
+        if stop_fn is not None and stop_fn(result):
+            break
     if pbar is not None:
         pbar.close()
     jax.block_until_ready(trainables)

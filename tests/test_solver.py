@@ -330,6 +330,41 @@ def test_causal_weighting_trains_and_reports_w_last():
             [2, 8, 1], f_model, steady, [], causal_eps=1.0)
 
 
+def test_causal_eps_ladder_anneals():
+    """compile(causal_eps=[...]) — the staged annealing schedule of Wang
+    et al. 2203.07404 Alg. 1: Adam starts at the smallest ε and advances
+    when the gate opens (w_last > causal_delta at a chunk boundary); the
+    full epoch budget is spent across stages."""
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+
+    dom = DomainND(["x", "t"], time_var="t")
+    dom.add("x", [-1.0, 1.0], 32)
+    dom.add("t", [0.0, 1.0], 8)
+    dom.generate_collocation_points(256, seed=0)
+    init = IC(dom, [lambda x: np.sin(np.pi * x)], var=[["x"]])
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
+
+    m = CollocationSolverND(verbose=False)
+    # first stage's gate opens essentially immediately (ε=1e-4 keeps
+    # exp(-ε·Σ)≈1 for any sane loss scale), so the run must advance
+    m.compile([2, 16, 16, 1], f_model, dom, [init],
+              causal_eps=[1e-4, 5.0], causal_bins=8, causal_delta=0.9)
+    assert m.causal_eps == 1e-4          # ladder starts at the smallest ε
+    m.fit(tf_iter=20, chunk=5)
+    assert m.causal_eps == 5.0           # ... and advanced when it opened
+    assert len(m.losses) == 20           # budget spent across stages
+    w = float(m.losses[-1]["Causal_w_last_0"])
+    assert 0.0 < w <= 1.0
+    assert np.isfinite(float(m.losses[-1]["Total Loss"]))
+
+    # a descending sequence is normalised to ascending order
+    m2 = CollocationSolverND(verbose=False)
+    m2.compile([2, 8, 1], f_model, dom, [init], causal_eps=[1.0, 0.01])
+    assert m2.causal_ladder == [0.01, 1.0] and m2.causal_eps == 0.01
+
+
 def test_causal_type2_with_g_matches_noncausal_semantics():
     """With one causal bin the bin-mean equals the global mean, so the
     causal residual term must reproduce g_MSE's per-point g(lambda)

@@ -133,6 +133,56 @@ def test_cache_staleness_fields(tmp_path):
     assert bench.probe_failure_streak() == 0
 
 
+def test_precision_hint_adopts_measured_best_bf16(tmp_path, monkeypatch):
+    """The headline run adopts a bf16 fused config only when the promoted
+    precision artifact measured it best ON TPU — never the net-dtype
+    config, never off-TPU, and BENCH_DTYPE=f32 disables it."""
+    bench = _load_bench()
+    bench.TPU_CACHE_DIR = str(tmp_path)
+    art_path = tmp_path / "BENCH_TPU_precision.json"
+
+    # CPU backend (the test env): never hints
+    assert bench.precision_hint() == (None, None)
+
+    import jax
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert bench.precision_hint() == (None, None)  # no artifact yet
+
+    art = {"backend": "tpu", "precision": {
+        "f32-highest": {"pts_per_sec": 100.0},
+        "bf16-taylor": {"pts_per_sec": 200.0},
+        "bf16-pallas": {"pts_per_sec": 300.0},
+        "bf16-matmul": {"pts_per_sec": 50.0},
+        "broken": {"error": "Mosaic"}}}
+    art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == ("pallas", "bfloat16")
+
+    # the backend gate must hold even WITH a valid artifact present
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert bench.precision_hint() == (None, None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    # an explicit BENCH_ENGINE override wins outright: no dtype hint
+    monkeypatch.setenv("BENCH_ENGINE", "generic")
+    assert bench.precision_hint() == (None, None)
+    monkeypatch.delenv("BENCH_ENGINE")
+
+    art["precision"]["bf16-pallas"]["pts_per_sec"] = 150.0
+    art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == (True, "bfloat16")
+
+    # the net-dtype config carries no end-to-end accuracy evidence:
+    # even when fastest it must not be hinted
+    art["precision"]["bf16-matmul"]["pts_per_sec"] = 900.0
+    art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == (None, None)
+
+    art["precision"]["bf16-matmul"]["pts_per_sec"] = 1.0
+    art_path.write_text(json.dumps(art) + "\n")
+    monkeypatch.setenv("BENCH_DTYPE", "f32")
+    assert bench.precision_hint() == (None, None)
+
+
 def test_tpu_cache_rejects_non_hardware(tmp_path):
     bench = _load_bench()
     bench.TPU_CACHE_DIR = str(tmp_path)

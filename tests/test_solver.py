@@ -294,12 +294,9 @@ def test_eager_newton_matches_reference_fixed_step_mode():
     assert s.min_loss["l-bfgs"] < float(l0)
 
 
-def test_causal_weighting_trains_and_reports_w_last():
-    """compile(causal_eps=...) — causality-gated residual (beyond-reference):
-    w_last is tracked per epoch, composes with SA per-point lambda, and a
-    steady-state domain is rejected with a typed error."""
-    import pytest
-    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+def _heat_causal_problem():
+    """Shared tiny heat-equation setup for the causal-weighting tests."""
+    from tensordiffeq_tpu import DomainND, IC, grad
 
     dom = DomainND(["x", "t"], time_var="t")
     dom.add("x", [-1.0, 1.0], 32)
@@ -310,6 +307,17 @@ def test_causal_weighting_trains_and_reports_w_last():
     def f_model(u, x, t):
         return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
 
+    return dom, init, f_model
+
+
+def test_causal_weighting_trains_and_reports_w_last():
+    """compile(causal_eps=...) — causality-gated residual (beyond-reference):
+    w_last is tracked per epoch, composes with SA per-point lambda, and a
+    steady-state domain is rejected with a typed error."""
+    import pytest
+    from tensordiffeq_tpu import CollocationSolverND, DomainND
+
+    dom, init, f_model = _heat_causal_problem()
     rng = np.random.RandomState(0)
     m = CollocationSolverND(verbose=False)
     m.compile([2, 16, 16, 1], f_model, dom, [init], Adaptive_type=1,
@@ -335,17 +343,9 @@ def test_causal_eps_ladder_anneals():
     et al. 2203.07404 Alg. 1: Adam starts at the smallest ε and advances
     when the gate opens (w_last > causal_delta at a chunk boundary); the
     full epoch budget is spent across stages."""
-    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad
+    from tensordiffeq_tpu import CollocationSolverND
 
-    dom = DomainND(["x", "t"], time_var="t")
-    dom.add("x", [-1.0, 1.0], 32)
-    dom.add("t", [0.0, 1.0], 8)
-    dom.generate_collocation_points(256, seed=0)
-    init = IC(dom, [lambda x: np.sin(np.pi * x)], var=[["x"]])
-
-    def f_model(u, x, t):
-        return grad(u, "t")(x, t) - 0.1 * grad(grad(u, "x"), "x")(x, t)
-
+    dom, init, f_model = _heat_causal_problem()
     m = CollocationSolverND(verbose=False)
     # first stage's gate opens essentially immediately (ε=1e-4 keeps
     # exp(-ε·Σ)≈1 for any sane loss scale), so the run must advance
@@ -363,6 +363,40 @@ def test_causal_eps_ladder_anneals():
     m2 = CollocationSolverND(verbose=False)
     m2.compile([2, 8, 1], f_model, dom, [init], causal_eps=[1.0, 0.01])
     assert m2.causal_ladder == [0.01, 1.0] and m2.causal_eps == 0.01
+
+
+def test_causal_ladder_composes_with_checkpoint_resume(tmp_path):
+    """The ladder's stage-offset re-basing through the checkpoint hook,
+    and the resume semantics the docstring promises: a restarted fit
+    restarts the ladder and fast-forwards through already-open stages;
+    the checkpoint carries a best iterate."""
+    from tensordiffeq_tpu import CollocationSolverND
+
+    dom, init, f_model = _heat_causal_problem()
+    ck = str(tmp_path / "ck")
+
+    def build():
+        m = CollocationSolverND(verbose=False)
+        m.compile([2, 16, 16, 1], f_model, dom, [init],
+                  causal_eps=[1e-4, 5.0], causal_bins=8, causal_delta=0.9)
+        return m
+
+    m = build()
+    # chunk 5 + checkpoint_every 5: the stage-2 leg runs with a nonzero
+    # epoch offset through the wrapped hook (the off>0 path)
+    m.fit(tf_iter=20, chunk=5, checkpoint_dir=ck, checkpoint_every=5)
+    assert m.causal_eps == 5.0 and len(m.losses) == 20
+
+    m2 = build()
+    m2.restore_checkpoint(ck)
+    assert m2.best_model["overall"] is not None  # best iterate restored
+    assert len(m2.losses) == 20
+    # ladder restarts at the smallest eps on the resumed fit and
+    # fast-forwards (stage-1 gate is open immediately at eps=1e-4)
+    m2.fit(tf_iter=10, chunk=5)
+    assert m2.causal_eps == 5.0
+    assert len(m2.losses) == 30
+    assert np.isfinite(float(m2.losses[-1]["Total Loss"]))
 
 
 def test_causal_type2_with_g_matches_noncausal_semantics():

@@ -365,6 +365,45 @@ def test_causal_eps_ladder_anneals():
     assert m2.causal_ladder == [0.01, 1.0] and m2.causal_eps == 0.01
 
 
+def test_optax_lr_schedules_through_compile():
+    """compile(lr=) and compile(lr_weights=) accept optax schedules, not
+    just floats (beyond-reference — the reference hardcodes a fixed Adam
+    rate, models.py:49-50): the labelled multi_transform passes them
+    straight to optax.adam, warm fit() restarts continue the schedule
+    from the persisted step count, and the SA λ ascent can run its own
+    decay."""
+    import optax
+    from tensordiffeq_tpu import CollocationSolverND
+
+    dom, init, f_model = _heat_causal_problem()
+    sched = optax.exponential_decay(5e-3, transition_steps=100,
+                                    decay_rate=0.5)
+    m = CollocationSolverND(verbose=False)
+    m.compile([2, 16, 1], f_model, dom, [init], lr=sched)
+    m.fit(tf_iter=20, chunk=5)
+    l0 = float(m.losses[-1]["Total Loss"])
+    assert np.isfinite(l0)
+    m.fit(tf_iter=10, chunk=5)  # warm restart reuses the schedule state
+    assert len(m.losses) == 30
+    assert np.isfinite(float(m.losses[-1]["Total Loss"]))
+    # the schedule really CONTINUED: the persisted optimizer step count
+    # covers both legs (a silent opt_state reset would read 10 here)
+    import jax
+    counts = [int(leaf) for leaf in jax.tree_util.tree_leaves(m.opt_state)
+              if getattr(leaf, "ndim", None) == 0
+              and np.issubdtype(np.asarray(leaf).dtype, np.integer)]
+    assert counts and max(counts) == 30, counts
+
+    rng = np.random.RandomState(0)
+    m2 = CollocationSolverND(verbose=False)
+    m2.compile([2, 16, 1], f_model, dom, [init], Adaptive_type=1,
+               dict_adaptive={"residual": [True], "BCs": [False]},
+               init_weights={"residual": [rng.rand(256, 1)], "BCs": [None]},
+               lr=sched, lr_weights=optax.cosine_decay_schedule(5e-3, 200))
+    m2.fit(tf_iter=20, chunk=5)
+    assert np.isfinite(float(m2.losses[-1]["Total Loss"]))
+
+
 def test_causal_ladder_composes_with_checkpoint_resume(tmp_path):
     """The ladder's stage-offset re-basing through the checkpoint hook,
     and the resume semantics the docstring promises: a restarted fit

@@ -70,7 +70,8 @@ class CollocationSolverND:
                 dict_adaptive: Optional[dict] = None,
                 init_weights: Optional[dict] = None,
                 g: Optional[Callable] = None, dist: bool = False,
-                network=None, lr: float = 0.005, lr_weights: float = 0.005,
+                network=None, lr: "float | Callable" = 0.005,
+                lr_weights: "float | Callable" = 0.005,
                 fused: Optional[bool] = None, fused_dtype=None,
                 causal_eps=None, causal_bins: int = 32,
                 causal_delta: float = 0.99,

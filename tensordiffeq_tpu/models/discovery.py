@@ -59,8 +59,10 @@ class DiscoveryModel:
     def compile(self, layer_sizes: Sequence[int], f_model: Callable, X, u,
                 var: Sequence[float], col_weights=None,
                 varnames: Optional[Sequence[str]] = None,
-                lr: float = 0.005, lr_vars: float = 0.005,
-                lr_weights: float = 0.005, seed: int = 0, verbose: bool = True,
+                lr: "float | Callable" = 0.005,
+                lr_vars=0.005,
+                lr_weights: "float | Callable" = 0.005,
+                seed: int = 0, verbose: bool = True,
                 fused: Optional[bool] = None, dist: bool = False,
                 network=None, g: Optional[Callable] = None):
         """Assemble the inverse problem (reference ``models.py:325-341``).

@@ -39,7 +39,8 @@ from ..utils import tree_copy
 from .progress import progress_bar
 
 
-def make_optimizer(lr: float = 0.005, lr_weights: float = 0.005,
+def make_optimizer(lr: "float | Callable" = 0.005,
+                   lr_weights: "float | Callable" = 0.005,
                    b1: float = 0.99, freeze_lambdas: bool = False
                    ) -> optax.GradientTransformation:
     """Adam for the network + Adam-ascent for λ (reference defaults
@@ -268,8 +269,8 @@ def fit_adam(loss_fn: Callable,
              X_f: jnp.ndarray,
              tf_iter: int,
              batch_sz: Optional[int] = None,
-             lr: float = 0.005,
-             lr_weights: float = 0.005,
+             lr: "float | Callable" = 0.005,
+             lr_weights: "float | Callable" = 0.005,
              chunk: int = 100,
              verbose: bool = True,
              result: Optional[FitResult] = None,

@@ -23,6 +23,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from .ops.losses import MSE, g_MSE  # re-export for parity  # noqa: F401
+from .ops.meshes import flatten_and_stack, multimesh  # noqa: F401
 from .sampling import LatinHypercubeSample  # noqa: F401
 
 

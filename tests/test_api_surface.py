@@ -1,0 +1,37 @@
+"""MIGRATION.md's "same path" claims, as a regression test.
+
+The migration guide promises that these reference symbols resolve at the
+SAME dotted path in this package (reference ``utils.py``/``plotting.py``/
+``helpers.py``; table in MIGRATION.md).  A rename or dropped re-export
+breaks real user code silently — this pins the whole table.
+"""
+
+import tensordiffeq_tpu as tdq
+
+SAME_PATH = {
+    "utils": ["constant", "tensor", "convertTensor",
+              "get_weights", "set_weights", "get_sizes",
+              "multimesh", "flatten_and_stack",
+              "MSE", "g_MSE", "LatinHypercubeSample"],
+    "plotting": ["plot_solution_domain1D", "plot_weights",
+                 "plot_glam_values", "plot_residuals", "get_griddata"],
+    "helpers": ["find_L2_error"],
+}
+
+TOP_LEVEL = ["CollocationSolverND", "DiscoveryModel", "DomainND",
+             "IC", "dirichletBC", "FunctionDirichletBC",
+             "FunctionNeumannBC", "periodicBC", "grad",
+             "find_L2_error", "MSE", "g_MSE"]
+
+
+def test_migration_same_path_symbols_resolve():
+    missing = [f"tdq.{mod}.{name}"
+               for mod, names in SAME_PATH.items()
+               for name in names
+               if not hasattr(getattr(tdq, mod), name)]
+    assert not missing, f"MIGRATION 'same path' broken for: {missing}"
+
+
+def test_top_level_reexports():
+    missing = [n for n in TOP_LEVEL if not hasattr(tdq, n)]
+    assert not missing, f"top-level re-exports missing: {missing}"

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Quiescent snapshot of the live AC-SA full CPU-hedge checkpoint.
+#
+# The live dir (runs/ac_sa_full_cpu_ckpt, gitignored) is rewritten every
+# eval boundary; copying it mid-write could ship a torn orbax manifest.
+# SIGSTOP the trainer, copy, SIGCONT — the copy is guaranteed consistent
+# (save_checkpoint's atomic swap means the dir is always either the old
+# or the new complete state while the process is stopped).  The snapshot
+# (runs/hedge_r5_ckpt) is committed so the next round can resume the run
+# via BENCH_FULL_CKPT=runs/hedge_r5_ckpt (or by copying it back).
+set -u
+cd "$(dirname "$0")/.."
+pid=$(pgrep -f cpu_ac_sa_full.py | head -1)
+[ -n "${pid:-}" ] && kill -STOP "$pid"
+trap '[ -n "${pid:-}" ] && kill -CONT "$pid"' EXIT
+src=runs/ac_sa_full_cpu_ckpt
+# killed-mid-swap fallback: the parked .old is the restorable one
+if [ ! -f "$src/tdq_meta.json" ] && [ -f "$src.old/tdq_meta.json" ]; then
+    src=$src.old
+fi
+if [ ! -f "$src/tdq_meta.json" ]; then
+    echo "no restorable hedge checkpoint found" >&2
+    exit 1
+fi
+rm -rf runs/hedge_r5_ckpt
+cp -r "$src" runs/hedge_r5_ckpt
+echo "snapshot: $(du -sh runs/hedge_r5_ckpt | cut -f1) from $src"

@@ -10,9 +10,20 @@
 # via BENCH_FULL_CKPT=runs/hedge_r5_ckpt (or by copying it back).
 set -u
 cd "$(dirname "$0")/.."
-pid=$(pgrep -f cpu_ac_sa_full.py | head -1)
+# match the python writer only (a bash wrapper/tail whose cmdline contains
+# the filename must not be the thing we STOP), and install the CONT restore
+# BEFORE stopping — an EXIT-only trap set after the STOP leaves the trainer
+# frozen forever if this script dies in between or on a signal
+# anchored to the start of the cmdline: a `bash -c 'python ...'` wrapper's
+# cmdline CONTAINS the python invocation but does not START with it, and
+# stopping the wrapper instead of the writer would copy a live dir
+pid=$(pgrep -f '^[^ ]*python[0-9.]* .*cpu_ac_sa_full\.py' | head -1)
+trap '[ -n "${pid:-}" ] && kill -CONT "$pid" 2>/dev/null' EXIT
+# a signal must RESUME AND STOP COPYING — falling through to cp after
+# SIGCONT would snapshot a live-rewritten dir, the torn state this script
+# exists to prevent (the EXIT trap's second kill -CONT is harmless)
+trap 'exit 130' INT TERM HUP
 [ -n "${pid:-}" ] && kill -STOP "$pid"
-trap '[ -n "${pid:-}" ] && kill -CONT "$pid"' EXIT
 src=runs/ac_sa_full_cpu_ckpt
 # killed-mid-swap fallback: the parked .old is the restorable one
 if [ ! -f "$src/tdq_meta.json" ] && [ -f "$src.old/tdq_meta.json" ]; then

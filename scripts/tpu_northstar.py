@@ -1,0 +1,245 @@
+"""North-star completion: drive the flagship AC-SA config to the SA-PINN
+accuracy bar (rel-L2 <= 2.1e-2, the paper number cited at reference
+``models.py:37``) on the real TPU, and record the time it takes.
+
+The 2026-08-01 parity capture (``BENCH_TPU_full.json``) ran the reference's
+exact 10k Adam + 10k L-BFGS budget in 190 s but landed at rel-L2 9.3e-2:
+the Adam curve was still dropping fast at cutoff (1.56e-1 -> 9.4e-2 over
+the last 2k epochs) and the L-BFGS phase stopped silently within its first
+chunks.  This driver answers both: it extends the Adam budget (at ~85
+epochs/s the budget costs seconds, not hours), instruments the L-BFGS
+phase (stop reasons now stream to stderr, ``training/lbfgs.py::_log_stop``),
+and falls back across refinement flavors — zoom line search, the
+reference's fixed-step rule (``optimizers.py:114``), generic-engine refine
+loss — until the bar is reached or the time budget is spent.
+
+Crash-safe and resumable (``runs/ns_ckpt`` + ``runs/ns_meta.json``): a
+tunnel death mid-run costs one leg, not the run.  Productive time is
+cumulative across windows, matching ``bench.bench_time_to_l2`` semantics.
+
+The final payload goes to ``runs/northstar.new``; it is promoted to
+``BENCH_TPU_northstar.json`` only when it ran on TPU (same gate as
+``scripts/_promote.sh``).
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)
+
+import numpy as np
+
+TARGET = 2.1e-2
+ADAM_LEG = int(os.environ.get("NS_ADAM_LEG", 5_000))
+ADAM_MAX = int(os.environ.get("NS_ADAM_MAX", 60_000))
+NEWTON_LEG = int(os.environ.get("NS_NEWTON_LEG", 5_000))
+BUDGET = float(os.environ.get("NS_BUDGET", 3_000))  # productive seconds
+N_F, NX, NT = 50_000, 512, 201
+WIDTHS = [128, 128, 128, 128]
+CKPT = os.path.join(REPO, "runs", "ns_ckpt")
+META = os.path.join(REPO, "runs", "ns_meta.json")
+OUT_STREAM = os.path.join(REPO, "runs", "northstar_stream.json")
+OUT_NEW = os.path.join(REPO, "runs", "northstar.new")
+CANON = os.path.join(REPO, "BENCH_TPU_northstar.json")
+
+
+def log(msg):
+    print(f"[ns] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    if jax.devices()[0].platform == "cpu":
+        log("backend is CPU — refusing to burn the flagship run off-chip")
+        return 3
+
+    import bench
+    from tensordiffeq_tpu.exact import allen_cahn_solution
+    from tensordiffeq_tpu.helpers import find_L2_error
+
+    xg, tg, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(xg, tg, indexing="ij"), -1).reshape(-1, 2)
+    u_star = usol.reshape(-1, 1)
+
+    solver, engine_used = bench.build_solver_fallback(
+        N_F, NX, NT, WIDTHS, bench.engine_hint(), "ns", grad_probe=True)
+
+    meta = {"adam_done": 0, "newton_done": 0, "t_prev": 0.0, "windows": 0,
+            "timeline": [], "t_target": None, "legs": []}
+    if os.path.exists(os.path.join(CKPT, "tdq_meta.json")) \
+            and os.path.exists(META):
+        try:
+            solver.restore_checkpoint(CKPT)
+            with open(META) as fh:
+                meta = json.load(fh)
+            # the checkpoint is newer than the meta when the trainer died
+            # MID-leg (fit checkpoints every 1000 epochs; meta's counters
+            # only advance when a leg completes) — trust the solver state:
+            # len(solver.losses) counts every Adam epoch + L-BFGS iter that
+            # actually ran (fit docstring contract), newton_done the L-BFGS
+            # share.  Without this a resume would replay the mid-leg epochs
+            # while reporting them only once.
+            ck_newton = int(getattr(solver, "newton_done", 0))
+            ck_adam = max(len(solver.losses) - ck_newton, 0)
+            meta["newton_done"] = max(meta["newton_done"], ck_newton)
+            meta["adam_done"] = max(meta["adam_done"], ck_adam)
+            log(f"resumed: {meta['adam_done']} Adam, {meta['newton_done']} "
+                f"L-BFGS, {meta['t_prev']:.0f}s productive, "
+                f"window #{meta['windows'] + 1}")
+        except Exception as e:
+            log(f"checkpoint not restorable ({type(e).__name__}: {e}); fresh")
+    meta["windows"] += 1
+    t0 = time.time()
+    Xg_j = None
+
+    def now():
+        return meta["t_prev"] + time.time() - t0
+
+    def eval_l2(params=None):
+        nonlocal Xg_j
+        import jax.numpy as jnp
+        if Xg_j is None:
+            Xg_j = jnp.asarray(Xg, jnp.float32)
+        p = solver.params if params is None else params
+        u_pred = np.asarray(solver._apply_jit(p, Xg_j))
+        return float(find_L2_error(u_pred, u_star))
+
+    def record(phase, abs_step, l2):
+        t = round(now(), 1)
+        meta["timeline"].append({"t": t, "phase": f"{phase}@{abs_step}",
+                                 "l2": l2})
+        if meta["t_target"] is None and l2 <= TARGET:
+            meta["t_target"] = t
+        log(f"t={t:7.1f}s {phase}@{abs_step}: rel-L2={l2:.3e}")
+
+    def persist(status):
+        meta_out = dict(meta, t_prev=round(now(), 1))
+        with open(META + ".tmp", "w") as fh:
+            json.dump(meta_out, fh)
+        os.replace(META + ".tmp", META)
+        payload = {
+            "metric": "AC-SA time-to-rel-L2<=2.1e-2 (north star)",
+            "value": meta["t_target"], "unit": "s",
+            "vs_baseline": meta["timeline"][-1]["l2"] if meta["timeline"]
+            else None,
+            "target": TARGET, "engine": engine_used,
+            "adam_done": meta["adam_done"], "newton_done": meta["newton_done"],
+            "windows": meta["windows"], "status": status,
+            "legs": meta["legs"], "timeline": meta["timeline"],
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "captured": time.strftime("%Y-%m-%d"),
+        }
+        with open(OUT_STREAM + ".tmp", "w") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(OUT_STREAM + ".tmp", OUT_STREAM)
+        return payload
+
+    def run_adam(n):
+        a0 = meta["adam_done"]
+
+        def eval_fn(phase, step, params):
+            record("adam", a0 + step, eval_l2(params))
+            persist("partial")
+
+        solver.fit(tf_iter=n, eval_fn=eval_fn, eval_every=1_000,
+                   checkpoint_dir=CKPT, checkpoint_every=1_000)
+        meta["adam_done"] = a0 + n
+        meta["legs"].append({"kind": "adam", "n": n, "t": round(now(), 1)})
+
+    def run_newton(n, eager=None, label="zoom"):
+        n0 = meta["newton_done"]
+
+        def eval_fn(phase, step, params):
+            record(f"l-bfgs[{label}]", n0 + step, eval_l2(params))
+            persist("partial")
+
+        before = eval_l2()
+        solver.fit(newton_iter=n, newton_eager=eager,
+                   eval_fn=eval_fn, eval_every=1_000,
+                   checkpoint_dir=CKPT, checkpoint_every=1_000)
+        # how far did it actually get?  fit credits actual iterations
+        ran = solver.newton_done - n0 if hasattr(solver, "newton_done") else n
+        meta["newton_done"] = n0 + max(int(ran), 0)
+        after = eval_l2()
+        record(f"l-bfgs[{label}]", meta["newton_done"], after)
+        meta["legs"].append({"kind": f"l-bfgs[{label}]", "n": int(ran),
+                             "l2_before": before, "l2_after": after,
+                             "t": round(now(), 1)})
+        persist("partial")
+        return before, after, int(ran)
+
+    # ---- schedule ----------------------------------------------------- #
+    # 1) make sure at least the reference Adam budget has run
+    if meta["adam_done"] < 10_000:
+        run_adam(10_000 - meta["adam_done"])
+        record("adam", meta["adam_done"], eval_l2())
+        persist("partial")
+
+    tried_eager = any(l["kind"] == "l-bfgs[eager]" for l in meta["legs"])
+    while now() < BUDGET and meta["adam_done"] <= ADAM_MAX:
+        l2 = eval_l2()
+        if l2 <= TARGET:
+            break
+        # 2) refinement attempt: zoom line search first
+        before, after, ran = run_newton(NEWTON_LEG, eager=None, label="zoom")
+        if after <= TARGET:
+            break
+        stalled = ran < NEWTON_LEG // 2 and (before - after) < 0.1 * before
+        if stalled and not tried_eager and now() < BUDGET:
+            # 3) reference-parity fixed-step rule as fallback
+            tried_eager = True
+            before, after, ran = run_newton(NEWTON_LEG, eager=True,
+                                            label="eager")
+            if after <= TARGET:
+                break
+        if now() >= BUDGET:
+            break
+        # 4) more Adam — measured to still be improving fast at 10k;
+        # the leg is clipped so the env-var cap is a true ceiling
+        leg = min(ADAM_LEG, ADAM_MAX - meta["adam_done"])
+        if leg <= 0:
+            break
+        run_adam(leg)
+        record("adam", meta["adam_done"], eval_l2())
+        persist("partial")
+
+    final_l2 = eval_l2()
+    # final timeline point — also sets t_target when a restored checkpoint
+    # already beat the bar before any in-loop record() fired
+    record("final", meta["adam_done"] + meta["newton_done"], final_l2)
+    done = final_l2 <= TARGET
+    status = "complete" if done else "partial"
+    payload = persist(status)
+    with open(OUT_NEW, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    log(f"final rel-L2={final_l2:.3e} after {meta['adam_done']} Adam + "
+        f"{meta['newton_done']} L-BFGS, {now():.0f}s productive, "
+        f"t_target={meta['t_target']}")
+    # promote (same gate as scripts/_promote.sh): real TPU payloads only;
+    # a complete artifact is never clobbered by a partial one
+    if payload["backend"] == "tpu":
+        canon_complete = False
+        if os.path.exists(CANON):
+            try:
+                with open(CANON) as fh:
+                    canon_complete = json.load(fh).get("status") == "complete"
+            except Exception:
+                pass
+        if done or not canon_complete:
+            os.replace(OUT_NEW, CANON)
+            log(f"promoted -> {CANON}")
+    if done:
+        import shutil
+        for d in (CKPT, CKPT + ".old", CKPT + ".tmp"):
+            shutil.rmtree(d, ignore_errors=True)
+    print(json.dumps({k: v for k, v in payload.items() if k != "timeline"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,17 @@ from ..utils import tree_copy
 from .progress import progress_bar
 
 
+def _log_stop(msg: str) -> None:
+    """Early-stop diagnostics go to stderr unconditionally: a silent stop
+    inside a long benchmark run is indistinguishable from a completed phase
+    in the artifact (the 2026-08-01 north-star TPU capture lost its L-BFGS
+    phase to an unexplained sub-1000-iter stop precisely because this was
+    gated on ``verbose``).  stderr, not stdout — bench workers speak
+    JSON-line protocol on stdout."""
+    import sys
+    print(f"[l-bfgs] {msg}", file=sys.stderr, flush=True)
+
+
 def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    memory_size: int = 50, tol_fun: float = 1e-12,
                    tol_grad: float = 1e-12, chunk: int = 100,
@@ -140,10 +151,17 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             pbar.set_postfix(loss=float(values[-1]))
         f_now = float(values[-1])
         if not np.isfinite(f_now):  # NaN stop (reference optimizers.py:290-291)
-            if verbose:
-                print("[l-bfgs] non-finite loss — stopping, keeping best iterate")
+            _log_stop(f"non-finite loss at iter {done} — "
+                      "stopping, keeping best iterate")
             break
-        if abs(f_prev - f_now) < tol_fun or float(gnorms[-1]) < tol_grad:
+        if abs(f_prev - f_now) < tol_fun:
+            _log_stop(f"tolerance stop at iter {done}: "
+                      f"|f_prev-f_now|={abs(f_prev - f_now):.3e} < "
+                      f"tol_fun={tol_fun:g} (f={f_now:.6e})")
+            break
+        if float(gnorms[-1]) < tol_grad:
+            _log_stop(f"gradient stop at iter {done}: "
+                      f"|g|={float(gnorms[-1]):.3e} < tol_grad={tol_grad:g}")
             break
         f_prev = f_now
     if pbar is not None:

@@ -46,7 +46,11 @@ fi
 echo "=== 2. precision axis (incl bf16-taylor + bf16-pallas) ==="
 # the bf16 single-pass MXU path is the measured MFU lever (PERF.md
 # roofline); its hardware capture is round-4 priority #2
-if have_complete precision; then echo "already captured"; else
+# re-run while the artifact carries a known-bad MFU row (mfu_note: the
+# 2026-08-01 capture predates the pallas-blind flop-basis fix)
+if have_complete precision \
+        && ! grep -q '"mfu_note"' BENCH_TPU_precision.json; then
+    echo "already captured"; else
     BENCH_BUDGET=2300 timeout 2500 python bench.py --precision \
         > runs/precision.new 2> runs/bench_precision_tpu.log
     promote precision

@@ -32,17 +32,25 @@ os.chdir(REPO)
 
 import numpy as np
 
-TARGET = 2.1e-2
-ADAM_LEG = int(os.environ.get("NS_ADAM_LEG", 5_000))
-ADAM_MAX = int(os.environ.get("NS_ADAM_MAX", 60_000))
-NEWTON_LEG = int(os.environ.get("NS_NEWTON_LEG", 5_000))
-BUDGET = float(os.environ.get("NS_BUDGET", 3_000))  # productive seconds
-N_F, NX, NT = 50_000, 512, 201
-WIDTHS = [128, 128, 128, 128]
-CKPT = os.path.join(REPO, "runs", "ns_ckpt")
-META = os.path.join(REPO, "runs", "ns_meta.json")
-OUT_STREAM = os.path.join(REPO, "runs", "northstar_stream.json")
-OUT_NEW = os.path.join(REPO, "runs", "northstar.new")
+SMOKE = os.environ.get("NS_SMOKE") == "1"  # tiny config, CPU allowed —
+# tests the leg scheduler/resume/promotion logic without a tunnel window
+TARGET = float(os.environ.get("NS_TARGET", 0.9 if SMOKE else 2.1e-2))
+ADAM_LEG = int(os.environ.get("NS_ADAM_LEG", 100 if SMOKE else 5_000))
+ADAM_MAX = int(os.environ.get("NS_ADAM_MAX", 400 if SMOKE else 60_000))
+NEWTON_LEG = int(os.environ.get("NS_NEWTON_LEG", 100 if SMOKE else 5_000))
+BUDGET = float(os.environ.get("NS_BUDGET", 300 if SMOKE else 3_000))
+if SMOKE:
+    N_F, NX, NT = 2_048, 64, 16
+    WIDTHS = [32, 32]
+else:
+    N_F, NX, NT = 50_000, 512, 201
+    WIDTHS = [128, 128, 128, 128]
+_SFX = "_smoke" if SMOKE else ""
+EVAL_EVERY = 50 if SMOKE else 1_000
+CKPT = os.path.join(REPO, "runs", f"ns_ckpt{_SFX}")
+META = os.path.join(REPO, "runs", f"ns_meta{_SFX}.json")
+OUT_STREAM = os.path.join(REPO, "runs", f"northstar_stream{_SFX}.json")
+OUT_NEW = os.path.join(REPO, "runs", f"northstar{_SFX}.new")
 CANON = os.path.join(REPO, "BENCH_TPU_northstar.json")
 
 
@@ -52,7 +60,7 @@ def log(msg):
 
 def main():
     import jax
-    if jax.devices()[0].platform == "cpu":
+    if jax.devices()[0].platform == "cpu" and not SMOKE:
         log("backend is CPU — refusing to burn the flagship run off-chip")
         return 3
 
@@ -82,10 +90,17 @@ def main():
             # actually ran (fit docstring contract), newton_done the L-BFGS
             # share.  Without this a resume would replay the mid-leg epochs
             # while reporting them only once.
-            ck_newton = int(getattr(solver, "newton_done", 0))
+            # Adam-phase checkpoints store newton_done=0 even when prior
+            # L-BFGS legs ran (collocation.py:1161-1164), so take the
+            # L-BFGS share from whichever source knows more BEFORE
+            # splitting losses into phases — else prior L-BFGS iters get
+            # counted as Adam epochs and later newton legs lose credit
+            ck_newton = max(int(getattr(solver, "newton_done", 0)),
+                            int(meta["newton_done"]))
             ck_adam = max(len(solver.losses) - ck_newton, 0)
-            meta["newton_done"] = max(meta["newton_done"], ck_newton)
+            meta["newton_done"] = ck_newton
             meta["adam_done"] = max(meta["adam_done"], ck_adam)
+            solver.newton_done = ck_newton  # fit's newton_prior: absolute
             log(f"resumed: {meta['adam_done']} Adam, {meta['newton_done']} "
                 f"L-BFGS, {meta['t_prev']:.0f}s productive, "
                 f"window #{meta['windows'] + 1}")
@@ -145,8 +160,8 @@ def main():
             record("adam", a0 + step, eval_l2(params))
             persist("partial")
 
-        solver.fit(tf_iter=n, eval_fn=eval_fn, eval_every=1_000,
-                   checkpoint_dir=CKPT, checkpoint_every=1_000)
+        solver.fit(tf_iter=n, eval_fn=eval_fn, eval_every=EVAL_EVERY,
+                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY)
         meta["adam_done"] = a0 + n
         meta["legs"].append({"kind": "adam", "n": n, "t": round(now(), 1)})
 
@@ -159,8 +174,8 @@ def main():
 
         before = eval_l2()
         solver.fit(newton_iter=n, newton_eager=eager,
-                   eval_fn=eval_fn, eval_every=1_000,
-                   checkpoint_dir=CKPT, checkpoint_every=1_000)
+                   eval_fn=eval_fn, eval_every=EVAL_EVERY,
+                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY)
         # how far did it actually get?  fit credits actual iterations
         ran = solver.newton_done - n0 if hasattr(solver, "newton_done") else n
         meta["newton_done"] = n0 + max(int(ran), 0)
@@ -173,9 +188,11 @@ def main():
         return before, after, int(ran)
 
     # ---- schedule ----------------------------------------------------- #
-    # 1) make sure at least the reference Adam budget has run
-    if meta["adam_done"] < 10_000:
-        run_adam(10_000 - meta["adam_done"])
+    # 1) make sure at least the reference Adam budget has run (capped by
+    # ADAM_MAX so a smoke/bounded run respects its ceiling)
+    first = min(10_000, ADAM_MAX)
+    if meta["adam_done"] < first:
+        run_adam(first - meta["adam_done"])
         record("adam", meta["adam_done"], eval_l2())
         persist("partial")
 
@@ -212,25 +229,36 @@ def main():
     # already beat the bar before any in-loop record() fired
     record("final", meta["adam_done"] + meta["newton_done"], final_l2)
     done = final_l2 <= TARGET
-    status = "complete" if done else "partial"
+    # "exhausted" is TERMINAL: the Adam ceiling was spent without reaching
+    # the bar — without it the watcher/extras queue would re-launch a
+    # 5000-iter refinement leg on every healthy probe forever
+    if done:
+        status = "complete"
+    elif meta["adam_done"] >= ADAM_MAX:
+        status = "exhausted"
+    else:
+        status = "partial"
     payload = persist(status)
     with open(OUT_NEW, "w") as fh:
         json.dump(payload, fh, indent=1)
         fh.write("\n")
     log(f"final rel-L2={final_l2:.3e} after {meta['adam_done']} Adam + "
         f"{meta['newton_done']} L-BFGS, {now():.0f}s productive, "
-        f"t_target={meta['t_target']}")
-    # promote (same gate as scripts/_promote.sh): real TPU payloads only;
-    # a complete artifact is never clobbered by a partial one
-    if payload["backend"] == "tpu":
-        canon_complete = False
+        f"t_target={meta['t_target']}, status={status}")
+    # promote (same gate as scripts/_promote.sh): real TPU payloads only —
+    # and never from a smoke run, whose toy config would close the
+    # watcher's north-star gate with a meaningless 'complete'.  A terminal
+    # artifact (complete/exhausted) is never clobbered by a partial one.
+    if payload["backend"] == "tpu" and not SMOKE:
+        canon_terminal = False
         if os.path.exists(CANON):
             try:
                 with open(CANON) as fh:
-                    canon_complete = json.load(fh).get("status") == "complete"
+                    canon_terminal = json.load(fh).get("status") in (
+                        "complete", "exhausted")
             except Exception:
                 pass
-        if done or not canon_complete:
+        if status in ("complete", "exhausted") or not canon_terminal:
             os.replace(OUT_NEW, CANON)
             log(f"promoted -> {CANON}")
     if done:

@@ -70,6 +70,16 @@ BENCH_BUDGET=1700 timeout 1800 python bench.py --engines \
     > runs/engines.new 2> runs/bench_engines_tpu.log
 promote engines
 
+echo "=== 4b. scale sweep (N_f 50k -> 500k single chip) ==="
+# VERDICT r4 #4: prove one v5e chip absorbs the reference's multi-GPU
+# config (AC-dist-new.py N_f=500k), with the remat HBM trade measured
+# (bench_scale retries OOM points with remat=True)
+if have_complete scale; then echo "already captured"; else
+    BENCH_BUDGET=2300 timeout 2500 python bench.py --scale \
+        > runs/scale.new 2> runs/bench_scale_tpu.log
+    promote scale
+fi
+
 echo "=== 5. on-hardware kernel parity tests ==="
 if [ -s runs/hwtests_tpu.log ] && grep -q "passed" runs/hwtests_tpu.log; then
     echo "already captured"

@@ -171,11 +171,20 @@ def test_precision_hint_adopts_measured_best_bf16(tmp_path, monkeypatch):
     art_path.write_text(json.dumps(art) + "\n")
     assert bench.precision_hint() == (True, "bfloat16")
 
-    # the net-dtype config carries no end-to-end accuracy evidence:
-    # even when fastest it must not be hinted
+    # the net-dtype config carries no end-to-end accuracy evidence: even
+    # when fastest overall it is never ITSELF hinted — but it must not
+    # veto the best VALIDATED config either (2026-08-01: bf16-matmul
+    # edged bf16-pallas by 6% and the old all-or-nothing rule left the
+    # headline at half the validated mixed-precision throughput)
     art["precision"]["bf16-matmul"]["pts_per_sec"] = 900.0
     art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == (True, "bfloat16")
+
+    # ...and when no validated config beats the f32 rows, no hint at all
+    art["precision"]["f32-highest"]["pts_per_sec"] = 5000.0
+    art_path.write_text(json.dumps(art) + "\n")
     assert bench.precision_hint() == (None, None)
+    art["precision"]["f32-highest"]["pts_per_sec"] = 100.0
 
     art["precision"]["bf16-matmul"]["pts_per_sec"] = 1.0
     art_path.write_text(json.dumps(art) + "\n")

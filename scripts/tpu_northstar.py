@@ -196,7 +196,18 @@ def main():
         record("adam", meta["adam_done"], eval_l2())
         persist("partial")
 
+    def switch_to_generic_refine():
+        """Swap the L-BFGS loss to the generic autodiff engine — the
+        diagnosis lever for a refinement stall that is the fused/pallas
+        engine's fault rather than L-BFGS's (the generic engine is the
+        autotune cross-check oracle, so its gradients are the trusted
+        ones)."""
+        solver._refine_residual = None
+        solver._assemble_losses()
+        log("refine loss switched to the generic autodiff engine")
+
     tried_eager = any(l["kind"] == "l-bfgs[eager]" for l in meta["legs"])
+    tried_generic = any("generic" in l["kind"] for l in meta["legs"])
     while now() < BUDGET and meta["adam_done"] <= ADAM_MAX:
         l2 = eval_l2()
         if l2 <= TARGET:
@@ -207,10 +218,20 @@ def main():
             break
         stalled = ran < NEWTON_LEG // 2 and (before - after) < 0.1 * before
         if stalled and not tried_eager and now() < BUDGET:
-            # 3) reference-parity fixed-step rule as fallback
+            # 3a) reference-parity fixed-step rule as fallback
             tried_eager = True
             before, after, ran = run_newton(NEWTON_LEG, eager=True,
                                             label="eager")
+            if after <= TARGET:
+                break
+            stalled = ran < NEWTON_LEG // 2 and (before - after) < 0.1 * before
+        if stalled and not tried_generic and now() < BUDGET:
+            # 3b) both flavors stalled through the fused engine: try the
+            # generic-engine refine loss once (docstring contract)
+            tried_generic = True
+            switch_to_generic_refine()
+            before, after, ran = run_newton(NEWTON_LEG, eager=None,
+                                            label="zoom-generic")
             if after <= TARGET:
                 break
         if now() >= BUDGET:

@@ -1,0 +1,105 @@
+"""End-to-end accuracy of the WHOLE-NET bf16 config (``dtype=bfloat16``
+activations, the ``bf16-matmul`` precision-sweep row) vs f32.
+
+The 2026-08-01 precision sweep measured bf16-matmul as the overall
+throughput winner (19.46M pts/s, 18.3% MFU) — but ``bench.precision_hint``
+deliberately never hints it for the headline because, unlike the fused
+``fused_dtype="bfloat16"`` path (f32 accumulation, validated in
+``runs/bf16_accuracy.json``), the all-bf16 forward pass has no end-to-end
+accuracy evidence.  This run supplies that evidence either way: a
+validated win unlocks a ~13% faster headline; a loss is the documented
+reason the rule stands.
+
+Same protocol as ``cpu_bf16_accuracy.py``: Burgers, identical
+config/seed/budget, rel-L2 vs the Cole-Hopf solution; the f32 arm is
+reused from ``runs/bf16_acc_f32.json`` when present.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           python scripts/cpu_bf16_net_accuracy.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+OUT = os.path.join(ROOT, "runs", "bf16_net_accuracy.json")
+N_F, ADAM, NEWTON = 8_192, 4_000, 2_000
+
+
+def run_bf16_net_arm():
+    import jax.numpy as jnp
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
+                                  dirichletBC, grad, neural_net)
+    from tensordiffeq_tpu.exact import burgers_solution
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 256)
+    domain.add("t", [0.0, 1.0], 100)
+    domain.generate_collocation_points(N_F, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, 0.0, "x", "upper"),
+           dirichletBC(domain, 0.0, "x", "lower")]
+
+    def f_model(u, x, t):
+        return (grad(u, "t")(x, t) + u(x, t) * grad(u, "x")(x, t)
+                - (0.01 / np.pi) * grad(grad(u, "x"), "x")(x, t))
+
+    layers = [2, 20, 20, 20, 20, 1]
+    s = CollocationSolverND(verbose=False)
+    # bf16 nets bypass the fused engine (collocation.py: float32-only),
+    # exactly as in bench_precision's bf16-matmul row
+    s.compile(layers, f_model, domain, bcs,
+              network=neural_net(layers, dtype=jnp.bfloat16))
+    t0 = time.time()
+    s.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    x, t, usol = burgers_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = s.predict(Xg, best_model=True)
+    l2 = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"config": "net dtype=bfloat16 (bf16-matmul row)", "rel_l2": l2,
+            "wall_s": round(wall, 1)}
+
+
+def main():
+    results = {}
+    f32_part = os.path.join(ROOT, "runs", "bf16_acc_f32.json")
+    if os.path.exists(f32_part):
+        with open(f32_part) as fh:
+            results["f32"] = json.load(fh)
+    part = os.path.join(ROOT, "runs", "bf16_acc_netbf16.json")
+    if os.path.exists(part):
+        with open(part) as fh:
+            results["net-bf16"] = json.load(fh)
+    else:
+        print("[net-bf16] running...", flush=True)
+        results["net-bf16"] = run_bf16_net_arm()
+        with open(part, "w") as fh:
+            json.dump(results["net-bf16"], fh)
+    for k, v in results.items():
+        print(f"[{k}] rel-L2={v['rel_l2']:.3e}", flush=True)
+    f32_l2 = results.get("f32", {}).get("rel_l2")
+    net_l2 = results["net-bf16"]["rel_l2"]
+    # the validation bar: within 2x of the f32 arm's rel-L2 (the fused
+    # bf16 arm landed BETTER than f32; parity-class is what "validated"
+    # means, an order-of-magnitude loss is a fail)
+    verdict = ("validated" if f32_l2 is not None and net_l2 <= 2 * f32_l2
+               else "fails-accuracy")
+    out = {"config": f"Burgers N_f={N_F}, 2-20x4-1, {ADAM}+{NEWTON}, seed 0",
+           "arms": results, "verdict": verdict,
+           "note": "whole-net bf16 (dtype=bfloat16): the bf16-matmul "
+                   "precision-sweep row trained end-to-end vs f32"}
+    with open(OUT, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "arms"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -206,44 +206,73 @@ def main():
         solver._assemble_losses()
         log("refine loss switched to the generic autodiff engine")
 
-    tried_eager = any(l["kind"] == "l-bfgs[eager]" for l in meta["legs"])
+    # Schedule (revised after the 2026-08-01 live run): a refinement
+    # flavor that is PAYING is repeated until it stops paying, and Adam
+    # only runs when no refinement flavor progresses.  The first version
+    # tried each flavor once and then returned to Adam every round — on
+    # the live window the eager leg took rel-L2 9.35e-2 -> 3.73e-2 (still
+    # descending) and the follow-up Adam leg promptly UNDID it (5.9e-2):
+    # an Adam step at lr 5e-3 from an L-BFGS iterate walks off the
+    # refined minimum.  "Paying" = >=5% relative L2 drop over the leg
+    # (the stall predicate's complement: both 2026-08-01 full-size zoom
+    # runs froze rel-L2 to 4 digits, a degenerate-step signature).
     tried_generic = any("generic" in l["kind"] for l in meta["legs"])
+    working = None  # refinement flavor currently paying, from legs history
+    for l in reversed(meta["legs"]):
+        if l["kind"].startswith("l-bfgs") and "l2_before" in l:
+            if l["l2_after"] < 0.95 * l["l2_before"]:
+                working = ("eager" if "eager" in l["kind"] else "zoom")
+                if "generic" in l["kind"]:
+                    switch_to_generic_refine()
+            break
+
+    def paying(before, after):
+        return (before - after) >= 0.05 * before
+
     while now() < BUDGET and meta["adam_done"] <= ADAM_MAX:
         l2 = eval_l2()
         if l2 <= TARGET:
             break
-        # 2) refinement attempt: zoom line search first
-        before, after, ran = run_newton(NEWTON_LEG, eager=None, label="zoom")
-        if after <= TARGET:
-            break
-        # a stalled refinement is EITHER an early stop OR a full leg with
-        # ~no L2 progress — the 2026-08-01 full-size runs (TPU plain AND
-        # CPU periodic) both ran their zoom iterations to completion with
-        # rel-L2 frozen to 4 digits (zoom line search degenerating to
-        # near-zero steps at this scale), which the old
-        # few-iterations-AND-no-progress predicate classified as healthy
-        stalled = (before - after) < 0.05 * before
-        if stalled and not tried_eager and now() < BUDGET:
-            # 3a) reference-parity fixed-step rule as fallback
-            tried_eager = True
-            before, after, ran = run_newton(NEWTON_LEG, eager=True,
-                                            label="eager")
+        progressed = False
+        if working is not None:
+            # keep riding the proven flavor until it stops paying
+            before, after, ran = run_newton(
+                NEWTON_LEG, eager=(True if working == "eager" else None),
+                label=working)
             if after <= TARGET:
                 break
-            stalled = (before - after) < 0.05 * before
-        if stalled and not tried_generic and now() < BUDGET:
-            # 3b) both flavors stalled through the fused engine: try the
-            # generic-engine refine loss once (docstring contract)
-            tried_generic = True
-            switch_to_generic_refine()
-            before, after, ran = run_newton(NEWTON_LEG, eager=None,
-                                            label="zoom-generic")
-            if after <= TARGET:
+            progressed = paying(before, after)
+            if not progressed:
+                working = None
+        else:
+            # fresh refinement round: zoom line search, then the
+            # reference-parity fixed-step rule, then (once) the
+            # generic-engine refine loss as the engine-fault diagnostic
+            for flavor, eager in (("zoom", None), ("eager", True)):
+                if now() >= BUDGET:
+                    break
+                before, after, ran = run_newton(NEWTON_LEG, eager=eager,
+                                                label=flavor)
+                if after <= TARGET or paying(before, after):
+                    working = flavor
+                    progressed = True
+                    break
+            if working is None and not tried_generic and now() < BUDGET:
+                tried_generic = True
+                switch_to_generic_refine()
+                before, after, ran = run_newton(NEWTON_LEG, eager=None,
+                                                label="zoom-generic")
+                if after <= TARGET or paying(before, after):
+                    working = "zoom"
+                    progressed = True
+            if working is not None and eval_l2() <= TARGET:
                 break
+        if progressed:
+            continue
         if now() >= BUDGET:
             break
-        # 4) more Adam — measured to still be improving fast at 10k;
-        # the leg is clipped so the env-var cap is a true ceiling
+        # no refinement flavor is paying: more Adam — measured to still
+        # be improving fast at 10k; clipped so the env cap is a ceiling
         leg = min(ADAM_LEG, ADAM_MAX - meta["adam_done"])
         if leg <= 0:
             break

@@ -57,12 +57,16 @@ if have_complete precision \
 fi
 
 echo "=== 3. headline throughput (engine-hinted: skips autotune) ==="
-# re-run until the artifact is a live capture measured WITH the validated
-# mixed-precision config (precision_note present = the hint fired); after
-# that a re-pass has nothing to add and the window minutes go to extras
-if have_complete default \
-        && grep -q '"precision_note"' BENCH_TPU_default.json; then
-    echo "already captured (mixed-precision headline)"
+# re-run until the artifact was promoted AFTER the precision artifact it
+# takes its hint from (mtime ordering — the in-file "captured" field is
+# day-granular and cannot order two same-day captures; `-nt` is also true
+# when no precision artifact exists, i.e. no hint source to refresh
+# against).  After that a re-pass has nothing to add and the window
+# minutes go to extras.  Worst case after a fresh git checkout equalises
+# mtimes: one redundant (cheap, engine-hinted) headline run re-orders them.
+if have_complete default && ! grep -q '"mfu_note"' BENCH_TPU_default.json \
+        && [ BENCH_TPU_default.json -nt BENCH_TPU_precision.json ]; then
+    echo "already captured (headline newer than its precision hint source)"
 else
     BENCH_BUDGET=1700 timeout 1800 python bench.py \
         > runs/default.new 2> runs/bench_default_tpu.log

@@ -2,10 +2,10 @@
 # Re-capture the CPU evidence logs that CONVERGENCE.md cites but which
 # were lost to environment resets (the blanket `*.log` gitignore meant
 # earlier rounds never committed them; fixed 2026-08-01 with `!runs/*.log`).
-# Only rows byte-reproducible from example defaults are re-run here — the
-# lost reduced-config rows are superseded by this window's full-size TPU
-# captures instead.  nice 19 so a live TPU-window orchestration always
-# wins the core; idempotent via success markers.
+# Rows reproducible from example defaults (Poisson, Helmholtz) re-run
+# as-is; the reduced KdV/NLS rows re-run via the examples' CLI overrides
+# set to the recorded rows' exact configs.  nice 19 so a live TPU-window
+# orchestration always wins the core; idempotent via success markers.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p runs
@@ -28,5 +28,14 @@ step runs/poisson_full_cpu.log "Error u" \
 # Helmholtz full (N_f=10k, 2-50x4-1, 10k Adam + L-BFGS)
 step runs/helmholtz_full_cpu.log "Error u" \
     timeout 21600 python examples/steady_state_helmholtz.py
+
+# KdV reduced (N_f=8k, 2-30x4-1, 4k+3k — the recorded row's exact config)
+step runs/kdv_reduced_cpu.log "relative L2" \
+    timeout 14400 python examples/kdv.py --nf 8000 --adam 4000 --newton 3000
+
+# NLS reduced (N_f=8k, 2-64x4-2, 5k+5k — the recorded row's exact config)
+step runs/nls_reduced_cpu.log "Error u" \
+    timeout 21600 python examples/schrodinger.py --nf 8000 --width 64 \
+        --adam 5000 --newton 5000
 
 echo "cpu recapture queue done $(date -u)"

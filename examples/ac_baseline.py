@@ -39,6 +39,32 @@ def build_problem(n_f: int, nx: int = 512, nt: int = 201, seed: int = 0):
     return domain, bcs, f_model
 
 
+def build_sa_solver(n_f: int, nx: int, nt: int, widths, periodic=False,
+                    seed: int = 0, verbose: bool = False):
+    """The flagship SA config as ONE shared builder (reference
+    ``AC-SA.py:12,55-56,64``): λ_res ~ U[0,1] per collocation point,
+    λ_IC ~ 100·U[0,1] per IC point, minimax via Adaptive_type=1;
+    ``periodic=True`` swaps in the exactly-periodic harmonic ansatz
+    (beyond-reference ``periodic_net``, generic residual engine).  Used
+    by ``ac_sa.py``, the north-star drivers, and the CPU hedges so the
+    arms can never de-synchronize."""
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import CollocationSolverND
+
+    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt)
+    rng = np.random.RandomState(seed)
+    layers = [2, *widths, 1]
+    network = tdq.periodic_net(layers, domain, ["x"]) if periodic else None
+    solver = CollocationSolverND(verbose=verbose)
+    solver.compile(
+        layers, f_model, domain, bcs, Adaptive_type=1,
+        dict_adaptive={"residual": [True], "BCs": [True, False]},
+        init_weights={"residual": [rng.rand(n_f, 1)],
+                      "BCs": [100.0 * rng.rand(nx, 1), None]},
+        network=network)
+    return solver
+
+
 def evaluate(solver, args, name):
     x, t, usol = allen_cahn_solution()
     Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)

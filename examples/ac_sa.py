@@ -9,14 +9,11 @@ network descends.  (The reference script passes the stale string
 SURVEY §2.4.7; the working encoding is Adaptive_type=1.)
 """
 
-import numpy as np
-
 from _common import example_args, scaled, fit_resumable
 
-from ac_baseline import build_problem, evaluate
+from ac_baseline import build_sa_solver, evaluate
 
 import tensordiffeq_tpu as tdq
-from tensordiffeq_tpu import CollocationSolverND
 
 
 def main():
@@ -24,26 +21,15 @@ def main():
                         flags=("periodic-net",))
     n_f = scaled(args, 50_000, 2_000)
     nx = 512 if not args.quick else 64
-    domain, bcs, f_model = build_problem(n_f, nx=nx,
-                                         nt=201 if not args.quick else 21)
     widths = [128] * 4 if not args.quick else [32] * 2
-
-    rng = np.random.RandomState(0)
-    dict_adaptive = {"residual": [True], "BCs": [True, False]}
-    init_weights = {"residual": [rng.rand(n_f, 1)],
-                    "BCs": [100.0 * rng.rand(nx, 1), None]}
 
     # --periodic-net: beyond-reference exactly-periodic embedding ansatz
     # (networks.PeriodicMLP) — the x-periodicity the reference enforces
     # softly is built into the network, at the cost of the generic
     # (non-fused) residual engine.
-    network = (tdq.periodic_net([2, *widths, 1], domain, ["x"])
-               if args.periodic_net else None)
-
-    solver = CollocationSolverND()
-    solver.compile([2, *widths, 1], f_model, domain, bcs, Adaptive_type=1,
-                   dict_adaptive=dict_adaptive, init_weights=init_weights,
-                   network=network)
+    solver = build_sa_solver(n_f, nx, 201 if not args.quick else 21,
+                             widths, periodic=args.periodic_net,
+                             verbose=True)
     fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
                newton_iter=scaled(args, 10_000, 100))
     err = evaluate(solver, args, "ac_sa")

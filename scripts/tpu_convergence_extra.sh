@@ -130,15 +130,21 @@ elif healthy; then
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== H. AC-SA with the exactly-periodic embedding net (beyond-reference) ==="
-# same flagship config as ac_sa.py, ansatz periodic in x by construction;
-# compares against the plain AC-SA run (bench --full / step A) at equal
-# budget.  Uses the generic residual engine (embedding nets bypass the
-# MLP-only fused path) — fine on-chip, hours on CPU, hence TPU-gated.
-if done_marker runs/ac_sa_periodic_tpu.log "Error u"; then echo "done already"
+# same flagship config as ac_sa.py --periodic-net, driven by the
+# north-star scheduler (eager refinement fallback, resume, time-to-target
+# timeline) chasing the driver metric's literal bar rel-L2 <= 1e-3 —
+# plausible for this ansatz (7.7e-3 at one-fifth size on CPU) where plain
+# SA-PINN publishes 2.1e-2.  Generic residual engine (embedding nets
+# bypass the MLP-only fused path) — fine on-chip, hours on CPU, hence
+# TPU-gated.  Self-promotes to BENCH_TPU_northstar_periodic.json.
+if [ -s BENCH_TPU_northstar_periodic.json ] \
+        && grep -qE '"status": "(complete|exhausted)"' \
+            BENCH_TPU_northstar_periodic.json; then
+    echo "done already (terminal)"
 elif healthy; then
-    TDQ_CKPT=runs/ck_ac_sa_periodic timeout 5400 python examples/ac_sa.py --periodic-net \
-        > runs/ac_sa_periodic_tpu.log 2>&1
-    grep -a "Error u" runs/ac_sa_periodic_tpu.log || tail -3 runs/ac_sa_periodic_tpu.log
+    NS_ARM=periodic NS_BUDGET=2000 timeout 2600 python scripts/tpu_northstar.py \
+        >> runs/ac_sa_periodic_tpu.log 2>&1
+    tail -2 runs/ac_sa_periodic_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== I. Nonlinear Schrödinger (2-output system, N_f=20k, 10k+10k) ==="

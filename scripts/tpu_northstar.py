@@ -34,7 +34,16 @@ import numpy as np
 
 SMOKE = os.environ.get("NS_SMOKE") == "1"  # tiny config, CPU allowed —
 # tests the leg scheduler/resume/promotion logic without a tunnel window
-TARGET = float(os.environ.get("NS_TARGET", 0.9 if SMOKE else 2.1e-2))
+# NS_ARM=periodic swaps the plain MLP for the exactly-periodic harmonic
+# ansatz (networks.periodic_net, beyond-reference) at the SAME flagship
+# config and chases the driver metric's literal bar, rel-L2 <= 1e-3
+# (BASELINE.md north-star) — below what plain SA-PINN publishes (2.1e-2)
+# but plausibly within the ansatz's reach: at one-fifth size on CPU it
+# landed 7.7e-3 (`runs/cpu_ac_sa_periodic.json`).  Artifacts carry a
+# `_periodic` suffix and promote to BENCH_TPU_northstar_periodic.json.
+PERIODIC = os.environ.get("NS_ARM") == "periodic"
+TARGET = float(os.environ.get(
+    "NS_TARGET", 0.9 if SMOKE else (1e-3 if PERIODIC else 2.1e-2)))
 ADAM_LEG = int(os.environ.get("NS_ADAM_LEG", 100 if SMOKE else 5_000))
 ADAM_MAX = int(os.environ.get("NS_ADAM_MAX", 400 if SMOKE else 60_000))
 NEWTON_LEG = int(os.environ.get("NS_NEWTON_LEG", 100 if SMOKE else 5_000))
@@ -45,13 +54,29 @@ if SMOKE:
 else:
     N_F, NX, NT = 50_000, 512, 201
     WIDTHS = [128, 128, 128, 128]
-_SFX = "_smoke" if SMOKE else ""
+_SFX = ("_smoke" if SMOKE else "") + ("_periodic" if PERIODIC else "")
 EVAL_EVERY = 50 if SMOKE else 1_000
 CKPT = os.path.join(REPO, "runs", f"ns_ckpt{_SFX}")
 META = os.path.join(REPO, "runs", f"ns_meta{_SFX}.json")
 OUT_STREAM = os.path.join(REPO, "runs", f"northstar_stream{_SFX}.json")
 OUT_NEW = os.path.join(REPO, "runs", f"northstar{_SFX}.new")
-CANON = os.path.join(REPO, "BENCH_TPU_northstar.json")
+CANON = os.path.join(
+    REPO, "BENCH_TPU_northstar_periodic.json" if PERIODIC
+    else "BENCH_TPU_northstar.json")
+
+
+def build_periodic_solver():
+    """The flagship AC-SA config with the exactly-periodic ansatz, via
+    the ONE shared builder (`examples/ac_baseline.py::build_sa_solver` —
+    reference `AC-SA.py:12,55-56,64` + `periodic_net`).  Embedding nets
+    bypass the MLP-only fused engine, so this runs the generic autodiff
+    engine — fine on-chip (`BENCH_TPU_engines.json`: generic within 4%
+    of pallas at f32)."""
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from ac_baseline import build_sa_solver
+
+    return (build_sa_solver(N_F, NX, NT, WIDTHS, periodic=True),
+            "generic+periodic_net")
 
 
 def log(msg):
@@ -72,8 +97,11 @@ def main():
     Xg = np.stack(np.meshgrid(xg, tg, indexing="ij"), -1).reshape(-1, 2)
     u_star = usol.reshape(-1, 1)
 
-    solver, engine_used = bench.build_solver_fallback(
-        N_F, NX, NT, WIDTHS, bench.engine_hint(), "ns", grad_probe=True)
+    if PERIODIC:
+        solver, engine_used = build_periodic_solver()
+    else:
+        solver, engine_used = bench.build_solver_fallback(
+            N_F, NX, NT, WIDTHS, bench.engine_hint(), "ns", grad_probe=True)
 
     meta = {"adam_done": 0, "newton_done": 0, "t_prev": 0.0, "windows": 0,
             "timeline": [], "t_target": None, "legs": []}
@@ -136,7 +164,8 @@ def main():
             json.dump(meta_out, fh)
         os.replace(META + ".tmp", META)
         payload = {
-            "metric": "AC-SA time-to-rel-L2<=2.1e-2 (north star)",
+            "metric": (f"AC-SA{'+periodic_net' if PERIODIC else ''} "
+                       f"time-to-rel-L2<={TARGET:g} (north star)"),
             "value": meta["t_target"], "unit": "s",
             "vs_baseline": meta["timeline"][-1]["l2"] if meta["timeline"]
             else None,
@@ -216,12 +245,15 @@ def main():
     # refined minimum.  "Paying" = >=5% relative L2 drop over the leg
     # (the stall predicate's complement: both 2026-08-01 full-size zoom
     # runs froze rel-L2 to 4 digits, a degenerate-step signature).
-    tried_generic = any("generic" in l["kind"] for l in meta["legs"])
+    # the periodic arm's refine loss IS the generic engine already — the
+    # diagnostic switch would re-run an identical just-dried leg
+    tried_generic = PERIODIC \
+        or any("generic" in l["kind"] for l in meta["legs"])
     # the generic-engine switch is PERMANENT in-process (every leg after
     # it runs the generic refine loss, paying or not) — a faithful resume
     # re-applies it whenever any generic leg exists in history, not just
     # when the most recent leg paid
-    generic_on = tried_generic
+    generic_on = tried_generic and not PERIODIC
     if generic_on:
         switch_to_generic_refine()
     working = None  # refinement flavor currently paying, from legs history
@@ -303,12 +335,16 @@ def main():
     # already beat the bar before any in-loop record() fired
     record("final", meta["adam_done"] + meta["newton_done"], final_l2)
     done = final_l2 <= TARGET
-    # "exhausted" is TERMINAL: the Adam ceiling was spent without reaching
-    # the bar — without it the watcher/extras queue would re-launch a
-    # 5000-iter refinement leg on every healthy probe forever
+    # "exhausted" is TERMINAL: the Adam ceiling OR the cumulative
+    # productive budget was spent without reaching the bar — without it
+    # the watcher/extras queue would re-launch a flagship compile plus a
+    # 5000-iter refinement leg on every healthy probe forever.  (A window
+    # death mid-leg never lands here: the killed process writes no final
+    # status, the streamed meta stays "partial", and the next window
+    # resumes with budget remaining.)
     if done:
         status = "complete"
-    elif meta["adam_done"] >= ADAM_MAX:
+    elif meta["adam_done"] >= ADAM_MAX or now() >= BUDGET:
         status = "exhausted"
     else:
         status = "partial"

@@ -42,7 +42,13 @@ print('healthy')
             && grep -q "passed" runs/hwtests_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/ac_baseline_full_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/burgers_full_tpu.log 2>/dev/null \
-            && grep -aq "c1 = " runs/ac_discovery_full_nosa12k_tpu.log 2>/dev/null; then
+            && grep -aq "c1 = " runs/ac_discovery_full_nosa12k_tpu.log 2>/dev/null \
+            && grep -aq "c1 = " runs/ac_discovery_sa10k_tpu.log 2>/dev/null \
+            && grep -aq "relative L2" runs/kdv_full_tpu.log 2>/dev/null \
+            && grep -aq "final loss" runs/burgers2d_full_tpu.log 2>/dev/null \
+            && grep -qE '"status": "(complete|exhausted)"' BENCH_TPU_northstar_periodic.json 2>/dev/null \
+            && grep -aq "Error u" runs/schrodinger_full_tpu.log 2>/dev/null \
+            && grep -aq "improvement" runs/resample_ablation_tpu.log 2>/dev/null; then
             echo "done $(date +%H:%M:%S)" > "$STATE"
             exit 0
         fi

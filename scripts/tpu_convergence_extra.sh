@@ -129,6 +129,13 @@ elif healthy; then
     grep -a "final loss" runs/burgers2d_full_tpu.log || tail -3 runs/burgers2d_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
+echo "=== I. Nonlinear Schrödinger (2-output system, N_f=20k, 10k+10k) ==="
+if done_marker runs/schrodinger_full_tpu.log "Error u"; then echo "done already"
+elif healthy; then
+    TDQ_CKPT=runs/ck_schrodinger timeout 5400 python examples/schrodinger.py > runs/schrodinger_full_tpu.log 2>&1
+    grep -a "Error u" runs/schrodinger_full_tpu.log || tail -3 runs/schrodinger_full_tpu.log
+else echo "SKIP: tunnel unhealthy"; fi
+
 echo "=== H. AC-SA with the exactly-periodic embedding net (beyond-reference) ==="
 # same flagship config as ac_sa.py --periodic-net, driven by the
 # north-star scheduler (eager refinement fallback, resume, time-to-target
@@ -145,13 +152,6 @@ elif healthy; then
     NS_ARM=periodic NS_BUDGET=2000 timeout 2600 python scripts/tpu_northstar.py \
         >> runs/ac_sa_periodic_tpu.log 2>&1
     tail -2 runs/ac_sa_periodic_tpu.log
-else echo "SKIP: tunnel unhealthy"; fi
-
-echo "=== I. Nonlinear Schrödinger (2-output system, N_f=20k, 10k+10k) ==="
-if done_marker runs/schrodinger_full_tpu.log "Error u"; then echo "done already"
-elif healthy; then
-    TDQ_CKPT=runs/ck_schrodinger timeout 5400 python examples/schrodinger.py > runs/schrodinger_full_tpu.log 2>&1
-    grep -a "Error u" runs/schrodinger_full_tpu.log || tail -3 runs/schrodinger_full_tpu.log
 else echo "SKIP: tunnel unhealthy"; fi
 
 echo "=== G. resampling ablation (Burgers, fixed vs adaptive draw) ==="

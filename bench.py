@@ -152,7 +152,9 @@ def precision_hint():
     identifies removing the six-pass f32 multiplier as THE lever past
     ~9% MFU, and bf16 SA training is accuracy-validated end-to-end
     (``runs/bf16_accuracy.json``, CONVERGENCE.md).  The full-precision
-    net-dtype config (``bf16-matmul``) is never hinted: only the fused
+    net-dtype config (``bf16-matmul``) is never hinted — measured to FAIL
+    end-to-end accuracy (rel-L2 3.7x worse than f32 at equal budget,
+    ``runs/bf16_net_accuracy.json``): only the fused
     engines carry the end-to-end accuracy evidence.  ``BENCH_DTYPE=f32``
     disables the hint, and an explicit ``BENCH_ENGINE`` override wins
     outright (engine_hint's contract) — no dtype hint rides along with

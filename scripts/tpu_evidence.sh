@@ -95,6 +95,14 @@ if have_complete scale; then echo "already captured"; else
     promote scale
 fi
 
+echo "=== 4c. remat trade (N_f=50k/500k, remat off vs on) ==="
+# VERDICT r4 #4 tail: the remat HBM-for-FLOPs trade measured, not asserted
+if have_complete remat; then echo "already captured"; else
+    BENCH_BUDGET=2300 timeout 2500 python bench.py --remat \
+        > runs/remat.new 2> runs/bench_remat_tpu.log
+    promote remat
+fi
+
 echo "=== 5. on-hardware kernel parity tests ==="
 if [ -s runs/hwtests_tpu.log ] && grep -q "passed" runs/hwtests_tpu.log; then
     echo "already captured"

@@ -37,7 +37,7 @@ print('healthy')
         . scripts/_promote.sh
         if have_complete full && have_complete default \
             && have_complete precision && have_complete engines \
-            && have_complete scale \
+            && have_complete scale && have_complete remat \
             && grep -qE '"status": "(complete|exhausted)"' BENCH_TPU_northstar.json 2>/dev/null \
             && grep -q "passed" runs/hwtests_tpu.log 2>/dev/null \
             && grep -aq "Error u" runs/ac_baseline_full_tpu.log 2>/dev/null \

@@ -280,3 +280,29 @@ def test_scale_retries_oom_point_with_remat(monkeypatch):
         and (4096, True) in calls
     assert out["4096"]["engine"].endswith("+remat")
     assert out["4096"]["pts_per_sec"] == 123
+
+
+def test_remat_payload_edges(monkeypatch):
+    """--remat payload semantics: the headline value is the best measured
+    remat-ON rate; a missing remat-on point is disclosed, never silently
+    replaced by the remat-off rate; all-failed returns None (worker raises
+    instead of publishing an empty artifact)."""
+    bench = _load_bench()
+    f = bench.remat_payload
+    err = {"error": "RuntimeError: RESOURCE_EXHAUSTED"}
+    p50, p50r = {"pts_per_sec": 100}, {"pts_per_sec": 80}
+    p500, p500r = {"pts_per_sec": 90}, {"pts_per_sec": 70}
+
+    assert f({"50000": err, "50000+remat": err}) is None
+    # full sweep: value = biggest remat-on point, ratio vs its off twin
+    p = f({"50000": p50, "50000+remat": p50r,
+           "500000": p500, "500000+remat": p500r})
+    assert p["value"] == 70 and p["vs_baseline"] == round(70 / 90, 3)
+    assert "N_f=500000" in p["metric"] and "note" not in p
+    # remat-off failed everywhere but remat-on succeeded (the HBM-pressure
+    # scenario the mode exists for): no crash, ratio undefined
+    p = f({"50000": err, "50000+remat": p50r})
+    assert p["value"] == 80 and p["vs_baseline"] is None
+    # remat-on failed: off rate published WITH the disclosure note
+    p = f({"500000": p500, "500000+remat": err})
+    assert p["value"] == 90 and "note" in p

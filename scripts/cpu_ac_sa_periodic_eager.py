@@ -1,0 +1,80 @@
+"""Reduced periodic-ansatz AC-SA, eager-refinement arm.
+
+The recorded reduced periodic arm (``runs/cpu_ac_sa_periodic.json``,
+rel-L2 7.73e-3) ran the default zoom line search in its L-BFGS phase.
+The on-chip north-star diagnosis (2026-08-01) showed zoom degenerating at
+SA scale while the reference-parity fixed-step eager rule keeps paying —
+this arm measures that flavor difference at the reduced size: identical
+config/seed/budget to the recorded arm, ONLY change ``newton_eager=True``.
+Outcome either de-risks the extras-H full-size rel-L2<=1e-3 chase (eager
+meaningfully below 7.73e-3 here) or shows the reduced config's ansatz
+floor is flavor-independent.
+
+Crash-safe resume via fit(checkpoint_dir=).
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    nice -n 19 python scripts/cpu_ac_sa_periodic_eager.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples"))
+sys.path.insert(0, ROOT)
+
+N_F, NX, NT = 10_000, 512, 201
+WIDTHS = [64, 64, 64]
+ADAM, NEWTON = 10_000, 10_000
+CKPT = os.path.join(ROOT, "runs", "ck_ac_sa_periodic_eager_cpu")
+OUT = os.path.join(ROOT, "runs", "cpu_ac_sa_periodic_eager.json")
+
+
+def main():
+    from ac_baseline import build_sa_solver
+
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu.exact import allen_cahn_solution
+
+    solver = build_sa_solver(N_F, NX, NT, WIDTHS, periodic=True)
+
+    adam_done = newton_done = 0
+    if os.path.exists(os.path.join(CKPT, "tdq_meta.json")):
+        try:
+            solver.restore_checkpoint(CKPT)
+            newton_done = min(int(getattr(solver, "newton_done", 0)), NEWTON)
+            adam_done = min(len(solver.losses) - newton_done, ADAM)
+            print(f"[periodic-eager] resumed: {adam_done} Adam, "
+                  f"{newton_done} L-BFGS", flush=True)
+        except Exception as e:
+            print(f"[periodic-eager] checkpoint not restorable ({e}); fresh",
+                  flush=True)
+    t0 = time.time()
+    solver.fit(tf_iter=ADAM - adam_done, newton_iter=NEWTON - newton_done,
+               newton_eager=True, checkpoint_dir=CKPT, checkpoint_every=500)
+    wall = time.time() - t0
+
+    x, t, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    out = {"arm": "periodic_net SA, eager L-BFGS", "rel_l2": err,
+           "wall_s_this_session": round(wall, 1),
+           "config": f"N_f={N_F}, 2-64x3-1, {ADAM}+{NEWTON}, seed 0, "
+                     "newton_eager=True — otherwise identical to the "
+                     "recorded zoom arm (runs/cpu_ac_sa_periodic.json, "
+                     "rel-L2 7.73e-3)"}
+    with open(OUT + ".tmp", "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+    print(json.dumps(out), flush=True)
+    import shutil
+    for d in (CKPT, CKPT + ".old", CKPT + ".tmp"):
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Seed-robustness pair for the reduced SA-vs-vanilla Allen-Cahn control.
+
+The recorded pair (``runs/cpu_ac_sa_reduced.json``: SA 4.34e-2 vs vanilla
+5.43e-1, a 12.5× gap reproducing the SA-PINN paper's headline claim) is a
+single seed.  This runs the identical protocol at seed 1 — independent
+net init, collocation draw, and λ init — so the flagship scientific claim
+(per-point minimax rescues AC where vanilla fails) doesn't rest on one
+lucky draw.  Arms are checkpoint-free but each arm's result is written
+as soon as it finishes, so a session boundary costs one arm, not both.
+
+Usage: env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    nice -n 19 python scripts/cpu_ac_sa_reduced_seed1.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples"))
+sys.path.insert(0, ROOT)
+
+N_F, NX, NT = 10_000, 512, 201
+WIDTHS = [64, 64, 64]
+ADAM, NEWTON = 10_000, 10_000
+SEED = 1
+OUT = os.path.join(ROOT, "runs", "cpu_ac_sa_reduced_seed1.json")
+
+
+def run(adaptive: bool):
+    from ac_baseline import build_problem
+
+    import tensordiffeq_tpu as tdq
+    from tensordiffeq_tpu import CollocationSolverND
+    from tensordiffeq_tpu.exact import allen_cahn_solution
+
+    domain, bcs, f_model = build_problem(N_F, nx=NX, nt=NT, seed=SEED)
+    solver = CollocationSolverND(verbose=False, seed=SEED)
+    kw = {}
+    if adaptive:
+        rng = np.random.RandomState(SEED)
+        kw = dict(Adaptive_type=1,
+                  dict_adaptive={"residual": [True], "BCs": [True, False]},
+                  init_weights={"residual": [rng.rand(N_F, 1)],
+                                "BCs": [100.0 * rng.rand(NX, 1), None]})
+    solver.compile([2, *WIDTHS, 1], f_model, domain, bcs, **kw)
+    t0 = time.time()
+    solver.fit(tf_iter=ADAM, newton_iter=NEWTON)
+    wall = time.time() - t0
+
+    x, t, usol = allen_cahn_solution()
+    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
+    u_pred, _ = solver.predict(Xg, best_model=True)
+    err = float(tdq.find_L2_error(u_pred, usol.reshape(-1, 1)))
+    return {"adaptive": adaptive, "rel_l2": err, "wall_s": round(wall, 1),
+            "seed": SEED,
+            "config": f"N_f={N_F}, 2-{'x'.join(map(str, WIDTHS))}-1, "
+                      f"{ADAM} Adam + {NEWTON} L-BFGS"}
+
+
+def main():
+    results = {}
+    if os.path.exists(OUT):
+        with open(OUT) as fh:
+            results = json.load(fh).get("arms", {})
+    for name, adaptive in (("sa", True), ("vanilla", False)):
+        if name in results:
+            print(f"[{name}] cached: rel-L2={results[name]['rel_l2']:.3e}",
+                  flush=True)
+            continue
+        print(f"[{name}] running...", flush=True)
+        results[name] = run(adaptive)
+        payload = {"arms": results, "seed": SEED,
+                   "note": "independent-seed repeat of "
+                           "runs/cpu_ac_sa_reduced.json (seed 0: SA "
+                           "4.34e-2 vs vanilla 5.43e-1)"}
+        if "sa" in results and "vanilla" in results:
+            payload["gap"] = round(results["vanilla"]["rel_l2"]
+                                   / results["sa"]["rel_l2"], 2)
+        with open(OUT + ".tmp", "w") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(OUT + ".tmp", OUT)
+        print(f"[{name}] rel-L2={results[name]['rel_l2']:.3e}", flush=True)
+    print(json.dumps({k: v["rel_l2"] for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()

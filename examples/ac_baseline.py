@@ -47,15 +47,18 @@ def build_sa_solver(n_f: int, nx: int, nt: int, widths, periodic=False,
     ``periodic=True`` swaps in the exactly-periodic harmonic ansatz
     (beyond-reference ``periodic_net``, generic residual engine).  Used
     by ``ac_sa.py``, the north-star drivers, and the CPU hedges so the
-    arms can never de-synchronize."""
+    arms can never de-synchronize.  ``seed`` drives ALL THREE RNG
+    consumers — the collocation draw (``build_problem``), the network
+    init (``CollocationSolverND(seed=)``), and the λ init — so one seed
+    pins the whole run."""
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import CollocationSolverND
 
-    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt)
+    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt, seed=seed)
     rng = np.random.RandomState(seed)
     layers = [2, *widths, 1]
     network = tdq.periodic_net(layers, domain, ["x"]) if periodic else None
-    solver = CollocationSolverND(verbose=verbose)
+    solver = CollocationSolverND(verbose=verbose, seed=seed)
     solver.compile(
         layers, f_model, domain, bcs, Adaptive_type=1,
         dict_adaptive={"residual": [True], "BCs": [True, False]},

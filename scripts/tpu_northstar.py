@@ -139,7 +139,16 @@ def main():
     Xg_j = None
 
     def now():
+        # CUMULATIVE productive time across windows — reporting only
+        # (timelines, t_target, persisted meta); never a budget gate
         return meta["t_prev"] + time.time() - t0
+
+    def window_elapsed():
+        # THIS window's productive share — the quantity NS_BUDGET caps
+        # (per-window yield, tpu_convergence_extra.sh:41): a window that
+        # spends its share exits "partial" and the next window resumes
+        # with its own full share
+        return time.time() - t0
 
     def eval_l2(params=None):
         nonlocal Xg_j
@@ -271,7 +280,12 @@ def main():
 
     last_dried = None  # flavor that just stopped paying — skip its
     # immediate retry in the fresh round that follows
-    while now() < BUDGET and meta["adam_done"] <= ADAM_MAX:
+    # the cumulative backstop also gates the LOOP: a window resuming with
+    # now() already past it must fall straight through to the terminal
+    # status, not burn a full share first
+    total_budget = float(os.environ.get("NS_TOTAL_BUDGET", 10 * BUDGET))
+    while window_elapsed() < BUDGET and now() < total_budget \
+            and meta["adam_done"] <= ADAM_MAX:
         l2 = eval_l2()
         if l2 <= TARGET:
             break
@@ -297,7 +311,7 @@ def main():
             # reference-parity fixed-step rule, then (once) the
             # generic-engine refine loss as the engine-fault diagnostic
             for flavor, eager in (("zoom", None), ("eager", True)):
-                if flavor == last_dried or now() >= BUDGET:
+                if flavor == last_dried or window_elapsed() >= BUDGET:
                     continue
                 before, after, ran = run_newton(NEWTON_LEG, eager=eager,
                                                 label=leg_label(flavor))
@@ -305,7 +319,8 @@ def main():
                     working = flavor
                     progressed = True
                     break
-            if working is None and not tried_generic and now() < BUDGET:
+            if working is None and not tried_generic \
+                    and window_elapsed() < BUDGET:
                 tried_generic = True
                 switch_to_generic_refine()
                 generic_on = True
@@ -319,7 +334,7 @@ def main():
                 break
         if progressed:
             continue
-        if now() >= BUDGET:
+        if window_elapsed() >= BUDGET:
             break
         # no refinement flavor is paying: more Adam — measured to still
         # be improving fast at 10k; clipped so the env cap is a ceiling
@@ -335,16 +350,23 @@ def main():
     # already beat the bar before any in-loop record() fired
     record("final", meta["adam_done"] + meta["newton_done"], final_l2)
     done = final_l2 <= TARGET
-    # "exhausted" is TERMINAL: the Adam ceiling OR the cumulative
-    # productive budget was spent without reaching the bar — without it
-    # the watcher/extras queue would re-launch a flagship compile plus a
-    # 5000-iter refinement leg on every healthy probe forever.  (A window
-    # death mid-leg never lands here: the killed process writes no final
-    # status, the streamed meta stays "partial", and the next window
-    # resumes with budget remaining.)
+    # "exhausted" is TERMINAL: the Adam ceiling was spent without reaching
+    # the bar — without it the watcher/extras queue would re-launch a
+    # flagship compile plus a 5000-iter refinement leg on every healthy
+    # probe forever.  NS_BUDGET is a PER-WINDOW cap (window_elapsed above;
+    # tpu_convergence_extra.sh:41): a window that merely spent its share
+    # exits "partial" and the next window resumes toward the ceiling —
+    # cumulative now() never gates a window's work.  But adam_done only
+    # advances when NO refinement flavor pays, so a Newton chase that
+    # keeps paying 5% per leg while asymptoting above TARGET would never
+    # hit the Adam ceiling: NS_TOTAL_BUDGET (cumulative productive time,
+    # default 10 windows' worth) is the terminal backstop for that path.
+    # (A window death mid-leg never lands here either: the killed process
+    # writes no final status, the streamed meta stays "partial", and the
+    # next window resumes.)
     if done:
         status = "complete"
-    elif meta["adam_done"] >= ADAM_MAX or now() >= BUDGET:
+    elif meta["adam_done"] >= ADAM_MAX or now() >= total_budget:
         status = "exhausted"
     else:
         status = "partial"

@@ -31,7 +31,7 @@ Quick start (Burgers)::
 from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
 from . import parallel, plotting, profiling, sampling, training, utils  # noqa: F401
-from . import models  # noqa: F401
+from . import models, serving  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
 from .domains import DomainND  # noqa: F401
@@ -41,5 +41,6 @@ from .networks import (MLP, FourierMLP, PeriodicMLP, fourier_net,  # noqa: F401
                        neural_net, periodic_net)
 from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
+from .serving import InferenceEngine, RequestBatcher, Surrogate  # noqa: F401
 
 __version__ = "0.3.0"  # kept in sync with pyproject.toml

@@ -1070,6 +1070,22 @@ class CollocationSolverND:
         return np.asarray(u_star), f_np
 
     # ------------------------------------------------------------------ #
+    def export_surrogate(self, best_model: bool = False):
+        """Export the trained solution as a deployable
+        :class:`~tensordiffeq_tpu.serving.Surrogate`: network + params +
+        the ``u``/derivative/residual closures, with **no training state**
+        (no optimizer moments, no λ, no collocation set).  The artifact
+        ``save``s through the checkpoint backend and restores in a fresh
+        process (``Surrogate.load(path, f_model=...)``); batched queries go
+        through ``surrogate.engine()``.  ``best_model=True`` exports the
+        best iterate, as in :meth:`predict`."""
+        if not self._compiled and not getattr(self, "_loaded", False):
+            raise RuntimeError("Call compile(...) or load_model(...) "
+                               "before export_surrogate()")
+        from ..serving import Surrogate
+        return Surrogate.from_solver(self, best_model=best_model)
+
+    # ------------------------------------------------------------------ #
     def save_checkpoint(self, path: str):
         """Checkpoint the FULL training state — params, SA λ, Adam moments,
         loss history — under directory ``path`` (what the reference cannot
@@ -1168,23 +1184,10 @@ class CollocationSolverND:
     _SAVE_MAGIC = b"TDQM"
 
     def _arch_meta(self) -> dict:
-        act = getattr(self.net, "activation", None)
-        meta = {"format": 1,
-                "layer_sizes": list(self.layer_sizes),
-                "activation": getattr(act, "__name__", str(act)),
-                "network_type": type(self.net).__name__,
-                "n_out": self.n_out}
-        # embedding-net hyperparameters, so load_model can rebuild them
-        from ..networks import FourierMLP, PeriodicMLP
-        if type(self.net) is FourierMLP:
-            meta["net_config"] = {"n_frequencies": self.net.n_frequencies,
-                                  "sigma": self.net.sigma,
-                                  "feature_seed": self.net.feature_seed}
-        elif type(self.net) is PeriodicMLP:
-            meta["net_config"] = {"periodic": [list(s) for s in
-                                               self.net.periodic],
-                                  "n_harmonics": self.net.n_harmonics}
-        return meta
+        # the one shared describe path (networks.net_metadata) — embedding-net
+        # hyperparameters ride along so load_model can rebuild them
+        from ..networks import net_metadata
+        return net_metadata(self.net, self.layer_sizes, self.n_out)
 
     def save(self, path: str):
         """Serialise the network — *self-describing*, like the reference's
@@ -1246,30 +1249,15 @@ class CollocationSolverND:
                 "this file has no architecture metadata (saved by an older "
                 "version); compile(...) the solver with the matching "
                 "layer_sizes first, then load_model")
-        ntype = meta.get("network_type")
-        rebuildable = ("MLP", "FourierMLP", "PeriodicMLP")
-        if ntype not in rebuildable \
-                or "tanh" not in str(meta.get("activation", "")):
+        from ..networks import net_from_metadata
+        try:
+            self.net = net_from_metadata(meta)
+        except ValueError as e:
             raise ValueError(
-                f"only tanh networks of type {rebuildable} can be "
-                f"reconstructed from metadata (file has {ntype}/"
-                f"{meta.get('activation')}); build the custom network "
-                "yourself and compile(..., network=...) before load_model")
+                f"{e}; here: compile(..., network=...) before load_model") \
+                from None
         self.layer_sizes = list(meta["layer_sizes"])
         self.n_out = int(meta.get("n_out", self.layer_sizes[-1]))
-        if ntype == "FourierMLP":
-            from ..networks import FourierMLP
-            self.net = FourierMLP(layer_sizes=tuple(self.layer_sizes),
-                                  **meta["net_config"])
-        elif ntype == "PeriodicMLP":
-            from ..networks import PeriodicMLP
-            cfg = meta["net_config"]
-            self.net = PeriodicMLP(
-                layer_sizes=tuple(self.layer_sizes),
-                periodic=tuple(tuple(s) for s in cfg["periodic"]),
-                n_harmonics=cfg["n_harmonics"])
-        else:
-            self.net = neural_net(self.layer_sizes)
         template = self.net.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, self.layer_sizes[0]), jnp.float32))

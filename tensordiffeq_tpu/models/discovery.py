@@ -458,6 +458,20 @@ class DiscoveryModel:
         return self
 
     # ------------------------------------------------------------------ #
+    def export_surrogate(self):
+        """Export the learned solution AND the learned PDE as a deployable
+        :class:`~tensordiffeq_tpu.serving.Surrogate`: the current
+        coefficient estimates are frozen into the artifact (persisted in
+        its metadata), so a fresh-process restore —
+        ``Surrogate.load(path, f_model=f_model)`` with the original
+        ``f_model(u, var, *coords)`` — evaluates the learned equation's
+        residual without any training state."""
+        if not hasattr(self, "trainables"):
+            raise RuntimeError("Call compile(...) before export_surrogate()")
+        from ..serving import Surrogate
+        return Surrogate.from_discovery(self)
+
+    # ------------------------------------------------------------------ #
     def predict(self, X_star):
         X_star = jnp.asarray(X_star, jnp.float32)
         return np.asarray(self.apply_fn(self.trainables["params"], X_star))

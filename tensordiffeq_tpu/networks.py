@@ -168,3 +168,63 @@ def periodic_net(layer_sizes: Sequence[int], domain, periodic_vars,
 def init_params(model: nn.Module, n_in: int, key: jax.Array):
     """Initialise parameters for a pointwise network taking ``n_in`` coords."""
     return model.init(key, jnp.zeros((1, n_in), dtype=jnp.float32))
+
+
+# --------------------------------------------------------------------------- #
+# Architecture metadata: the one describe/rebuild pair shared by the solver's
+# self-describing save format (models/collocation.py::save) and the serving
+# surrogate artifact (serving/surrogate.py) — a net persisted by either can
+# be reconstructed in a fresh process with no solver object around.
+# --------------------------------------------------------------------------- #
+REBUILDABLE_NETS = ("MLP", "FourierMLP", "PeriodicMLP")
+
+
+def net_metadata(net: nn.Module, layer_sizes: Sequence[int],
+                 n_out: int) -> dict:
+    """JSON-serialisable architecture record for ``net``.
+
+    Embedding nets compute a fixed function of their config (Fourier B
+    matrix, harmonic spec), so the record carries ``net_config`` — loading
+    weights into a differently-configured embedding would be a *different*
+    function, which consumers must be able to detect.
+    """
+    act = getattr(net, "activation", None)
+    meta = {"format": 1,
+            "layer_sizes": list(layer_sizes),
+            "activation": getattr(act, "__name__", str(act)),
+            "network_type": type(net).__name__,
+            "n_out": int(n_out)}
+    if type(net) is FourierMLP:
+        meta["net_config"] = {"n_frequencies": net.n_frequencies,
+                              "sigma": net.sigma,
+                              "feature_seed": net.feature_seed}
+    elif type(net) is PeriodicMLP:
+        meta["net_config"] = {"periodic": [list(s) for s in net.periodic],
+                              "n_harmonics": net.n_harmonics}
+    return meta
+
+
+def net_from_metadata(meta: dict) -> MLP:
+    """Rebuild a network from a :func:`net_metadata` record.
+
+    Only the standard tanh families can be reconstructed without user code
+    (:data:`REBUILDABLE_NETS`); custom modules must be rebuilt by the caller
+    and handed in directly.
+    """
+    ntype = meta.get("network_type")
+    if ntype not in REBUILDABLE_NETS \
+            or "tanh" not in str(meta.get("activation", "")):
+        raise ValueError(
+            f"only tanh networks of type {REBUILDABLE_NETS} can be "
+            f"reconstructed from metadata (file has {ntype}/"
+            f"{meta.get('activation')}); build the custom network "
+            "yourself and pass it in explicitly")
+    layer_sizes = tuple(meta["layer_sizes"])
+    if ntype == "FourierMLP":
+        return FourierMLP(layer_sizes=layer_sizes, **meta["net_config"])
+    if ntype == "PeriodicMLP":
+        cfg = meta["net_config"]
+        return PeriodicMLP(layer_sizes=layer_sizes,
+                           periodic=tuple(tuple(s) for s in cfg["periodic"]),
+                           n_harmonics=cfg["n_harmonics"])
+    return neural_net(layer_sizes)

@@ -87,6 +87,20 @@ def stopwatch(label: str = "", sync: Optional[Any] = None,
             print(f"[profile] {label}: {out['elapsed_s']:.3f}s")
 
 
+def percentiles(samples, qs=(50, 90, 99)) -> dict[str, Optional[float]]:
+    """``{"p50": ..., "p90": ..., "p99": ...}`` summary of a latency sample
+    list (seconds), the serving-metrics companion to :func:`timeit` — the
+    request batcher (:mod:`tensordiffeq_tpu.serving.batcher`) and the
+    ``--serving`` benchmark report through this so percentile semantics
+    (linear interpolation, ``None`` for an empty window) never drift
+    between consumers."""
+    if not len(samples):
+        return {f"p{int(q)}": None for q in qs}
+    import numpy as np
+    arr = np.asarray(samples, dtype=np.float64)
+    return {f"p{int(q)}": float(np.percentile(arr, q)) for q in qs}
+
+
 def device_memory_stats() -> dict[str, dict]:
     """Per-device memory statistics (bytes in use / peak / limit) where the
     backend reports them; empty dict entries otherwise."""

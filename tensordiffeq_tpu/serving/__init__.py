@@ -1,0 +1,21 @@
+"""Sharded batch-inference serving: deploy a trained PINN as a surrogate.
+
+The train/infer split (PINNs-TF2, arXiv:2311.03626): training produces a
+:class:`Surrogate` artifact (net + params + residual closure, **no**
+training state), which restores in a fresh process and serves batched
+``u`` / derivative / residual queries through an :class:`InferenceEngine`
+(pad-to-bucket shape bucketing, bounded compile cache, donated buffers,
+optional query-axis sharding over the ``"data"`` mesh) fed by a
+:class:`RequestBatcher` (max-batch / max-latency coalescing with QPS and
+latency-percentile reporting).
+
+    sur = solver.export_surrogate()
+    sur.save("runs/ac_surrogate")
+    # fresh process:
+    engine = Surrogate.load("runs/ac_surrogate", f_model=f_model).engine()
+    u, f = engine.predict(X_grid)
+"""
+
+from .batcher import PendingQuery, RequestBatcher  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401
+from .surrogate import Surrogate  # noqa: F401

@@ -1,0 +1,191 @@
+"""Request coalescing: many small point queries -> one device batch.
+
+A serving front-end sees lots of tiny queries (single points, short rows of
+an adaptive sampler), and dispatching each to the device individually wastes
+the accelerator on launch overhead.  :class:`RequestBatcher` merges pending
+queries into one engine call under the standard serving policy pair:
+
+* **max_batch** — flush as soon as the pending point count reaches it
+  (device-utilisation bound);
+* **max_latency_s** — flush when the oldest pending request has waited this
+  long (tail-latency bound; checked by :meth:`poll`, which hosts call from
+  their event loop, or implicitly by a blocking :meth:`result`).
+
+Per-request latency (submit -> result ready) and throughput are recorded and
+summarised through :func:`tensordiffeq_tpu.profiling.percentiles` /
+:func:`~tensordiffeq_tpu.profiling.stopwatch`, so a ``--serving`` benchmark
+or an operator dashboard reads QPS and p50/p90/p99 straight off
+:meth:`stats`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..profiling import percentiles, stopwatch
+
+
+class PendingQuery:
+    """Handle returned by :meth:`RequestBatcher.submit`."""
+
+    __slots__ = ("_batcher", "_value", "_error", "_done")
+
+    def __init__(self, batcher):
+        self._batcher = batcher
+        self._value = None
+        self._error = None
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def result(self):
+        """The query's rows of the merged batch result.  If the batch has
+        not flushed yet, forces a flush (a caller blocking on a result is
+        the latency deadline in person).  A batch whose op raised delivers
+        that exception to EVERY waiter, not just whoever triggered the
+        flush."""
+        if not self._done:
+            self._batcher.flush()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _set(self, value):
+        self._value = value
+        self._done = True
+
+    def _fail(self, exc: Exception):
+        self._error = exc
+        self._done = True
+
+
+class RequestBatcher:
+    """Coalesce point queries into device batches under a max-batch /
+    max-latency policy.
+
+    Args:
+      engine: an :class:`~tensordiffeq_tpu.serving.InferenceEngine`; the
+        default op is ``engine.u``.
+      op: override the batched op (e.g. ``engine.residual`` or a
+        ``lambda X: engine.derivative(X, "x")``) — anything mapping
+        ``[N, ndim] -> [N, ...]`` rows (or a tuple of such, for
+        multi-equation residuals).
+      max_batch: flush when this many points are pending.
+      max_latency_s: flush when the oldest pending request is this old.
+      clock: time source (injectable for tests); defaults to
+        ``time.monotonic``.
+    """
+
+    def __init__(self, engine=None, op: Optional[Callable] = None,
+                 max_batch: int = 4096, max_latency_s: float = 0.01,
+                 clock: Callable[[], float] = time.monotonic):
+        if op is None:
+            if engine is None:
+                raise ValueError("pass an engine or an explicit op")
+            op = engine.u
+        self._op = op
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_s)
+        self._clock = clock
+        self._pending: list = []   # (X, handle, t_submit)
+        self._pending_pts = 0
+        self._first_submit: Optional[float] = None
+        self._latencies: list = []
+        self._batch_walls: list = []
+        self._n_requests = 0
+        self._n_batches = 0
+        self._n_points = 0
+        self._n_failed = 0
+        self._last_flush: Optional[float] = None
+
+    # ------------------------------------------------------------------ #
+    @property
+    def pending_points(self) -> int:
+        return self._pending_pts
+
+    def submit(self, X) -> PendingQuery:
+        """Queue a ``[n, ndim]`` (or single-point ``[ndim]``) query; returns
+        a :class:`PendingQuery`.  Flushes inline when the pending point
+        count reaches ``max_batch``."""
+        X = np.atleast_2d(np.asarray(X, np.float32))
+        handle = PendingQuery(self)
+        now = self._clock()
+        if self._first_submit is None:
+            self._first_submit = now
+        self._pending.append((X, handle, now))
+        self._pending_pts += X.shape[0]
+        self._n_requests += 1
+        if self._pending_pts >= self.max_batch:
+            self.flush()
+        return handle
+
+    def poll(self) -> bool:
+        """Flush iff the oldest pending request has exceeded the latency
+        deadline.  Returns whether a flush happened."""
+        if self._pending and \
+                self._clock() - self._pending[0][2] >= self.max_latency_s:
+            self.flush()
+            return True
+        return False
+
+    def flush(self) -> int:
+        """Evaluate every pending query as one merged device batch and
+        deliver results to the handles.  Returns the number of requests
+        served."""
+        if not self._pending:
+            return 0
+        batch, self._pending = self._pending, []
+        self._pending_pts = 0
+        X = np.concatenate([x for x, _, _ in batch]) if len(batch) > 1 \
+            else batch[0][0]
+        try:
+            with stopwatch(verbose=False) as sw:
+                out = self._op(X)
+        except Exception as e:
+            # the queue is already cleared: deliver the failure to every
+            # coalesced waiter (their result() re-raises it) instead of
+            # dropping them with a silent None
+            for _, handle, _ in batch:
+                handle._fail(e)
+            self._n_failed += len(batch)
+            raise
+        done = self._clock()
+        offset = 0
+        for x, handle, t_submit in batch:
+            n = x.shape[0]
+            if isinstance(out, tuple):
+                handle._set(tuple(o[offset:offset + n] for o in out))
+            else:
+                handle._set(out[offset:offset + n])
+            offset += n
+            self._latencies.append(done - t_submit)
+        self._batch_walls.append(sw["elapsed_s"])
+        self._n_batches += 1
+        self._n_points += X.shape[0]
+        self._last_flush = done
+        return len(batch)
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        """Serving metrics over everything flushed so far: request/batch/
+        point counts, QPS over the observed span, mean device-batch wall,
+        and per-request latency percentiles (seconds)."""
+        span = None
+        if self._last_flush is not None and self._first_submit is not None:
+            span = self._last_flush - self._first_submit
+        served = self._n_requests - len(self._pending) - self._n_failed
+        return {
+            "requests": served,
+            "failed": self._n_failed,
+            "batches": self._n_batches,
+            "points": self._n_points,
+            "qps": None if not span else served / span,
+            "batch_wall_mean_s": (float(np.mean(self._batch_walls))
+                                  if self._batch_walls else None),
+            "latency_s": percentiles(self._latencies),
+        }

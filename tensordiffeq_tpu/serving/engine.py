@@ -1,0 +1,214 @@
+"""Batched, jit-cached query engine over a :class:`Surrogate`.
+
+``CollocationSolverND.predict`` jit-caches per *exact* query shape: a
+serving workload with varied query sizes pays a fresh XLA compile for every
+new shape it has ever seen — unbounded compile cache, unbounded tail
+latency.  The engine fixes both with **pad-to-bucket shape bucketing**:
+
+* query batches are zero-padded up to the next power-of-two bucket between
+  ``min_bucket`` and ``max_bucket`` (larger queries are split into
+  ``max_bucket`` chunks), so the set of shapes XLA ever compiles is the
+  bucket ladder — ``log2(max_bucket / min_bucket) + 1`` entries per query
+  kind, regardless of how many distinct query sizes arrive;
+* the padded device buffer is **donated** to the compiled program (it is
+  constructed fresh per query, so XLA may reuse its memory for outputs);
+* with ``shard=True`` the padded query axis is laid out over the
+  ``"data"`` axis of the :mod:`tensordiffeq_tpu.parallel` mesh — dense-grid
+  evaluation (e.g. PACMANN-style adaptive-sampling residual sweeps,
+  arXiv:2411.19632) runs data-parallel over every local device with
+  replicated params, same layout as training.
+
+Padding is sound because every query kind is *pointwise* along the batch
+axis (the MLP, its derivative chains, and the vmapped residual are all
+per-row programs): the engine's result is bit-identical to evaluating the
+same program on the padded batch and trimming.  Against
+``solver.predict`` that means: ``u`` matches bit-for-bit at every query
+size, and every kind matches bit-for-bit whenever the shapes agree (query
+size on a bucket boundary, or predict evaluated at the padded shape) —
+XLA only guarantees the *same compiled shape* produces the same bits, so
+an exact-shape residual compile can differ from the bucket-shape one in
+the last ulp of the autodiff chain.  A solver using a fused training
+engine agrees to engine tolerance (see ``ops/fused.py`` cross-checks).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.derivatives import d, make_ufn, vmap_residual
+from .surrogate import Surrogate
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+class InferenceEngine:
+    """Batched ``u`` / derivative / residual queries with bounded compiles.
+
+    Args:
+      surrogate: the :class:`Surrogate` to serve.
+      min_bucket / max_bucket: powers of two bounding the pad-to-bucket
+        ladder.  Every query batch compiles at one of the ladder sizes, so
+        the jit compile cache holds at most :attr:`n_buckets` programs per
+        query kind (``u`` / each distinct derivative / ``residual``).
+      shard: lay the padded query axis out over the ``"data"`` mesh axis
+        (all local devices, params replicated).  ``min_bucket`` must tile
+        the device count (powers of two always do for power-of-two meshes).
+      donate: donate the padded input buffer to the compiled program.
+    """
+
+    def __init__(self, surrogate: Surrogate, min_bucket: int = 256,
+                 max_bucket: int = 1 << 20, shard: bool = False,
+                 donate: bool = True):
+        if _next_pow2(min_bucket) != min_bucket \
+                or _next_pow2(max_bucket) != max_bucket:
+            raise ValueError("min_bucket and max_bucket must be powers of "
+                             f"two, got {min_bucket}/{max_bucket}")
+        if min_bucket > max_bucket:
+            raise ValueError(f"min_bucket {min_bucket} > max_bucket "
+                             f"{max_bucket}")
+        self.surrogate = surrogate
+        self._buckets = tuple(min_bucket << i for i in range(
+            (max_bucket // min_bucket).bit_length()))
+        # the CPU backend can't reuse donated buffers and warns per compile
+        self._donate = donate and jax.default_backend() != "cpu"
+        self._sharding = None
+        if shard:
+            from ..parallel import data_sharding, make_mesh
+            mesh = make_mesh()
+            n_dev = int(np.prod(mesh.devices.shape))
+            if min_bucket % n_dev:
+                raise ValueError(
+                    f"min_bucket {min_bucket} does not tile the "
+                    f"{n_dev}-device mesh")
+            self._sharding = data_sharding(mesh, ndim=2)
+        self._jitted: dict = {}      # kind -> jitted callable(params, X)
+        self._cache_keys: set = set()  # (kind, bucket) shapes ever compiled
+
+    # ------------------------------------------------------------------ #
+    @property
+    def bucket_sizes(self) -> tuple:
+        return self._buckets
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._buckets)
+
+    @property
+    def compile_cache_size(self) -> int:
+        """Distinct (query kind, bucket) programs compiled so far — bounded
+        by ``kinds_used * n_buckets`` no matter the query-shape mix."""
+        return len(self._cache_keys)
+
+    def bucket_for(self, n: int) -> int:
+        """The (deterministic) bucket a chunk of ``n`` rows pads to."""
+        return min(max(_next_pow2(n), self._buckets[0]), self._buckets[-1])
+
+    # ------------------------------------------------------------------ #
+    def _jit_for(self, kind, make_fn: Callable) -> Callable:
+        fn = self._jitted.get(kind)
+        if fn is None:
+            fn = jax.jit(make_fn(),
+                         donate_argnums=(1,) if self._donate else ())
+            self._jitted[kind] = fn
+        return fn
+
+    def _run(self, kind, make_fn: Callable, X: np.ndarray):
+        """Pad one ``<= max_bucket`` chunk to its bucket, run, trim."""
+        n = X.shape[0]
+        bucket = self.bucket_for(n)
+        if n < bucket:
+            X = np.concatenate(
+                [X, np.zeros((bucket - n, X.shape[1]), X.dtype)])
+        # shard straight from host — jnp.asarray first would commit the
+        # whole batch to device 0 and pay the transfer twice
+        Xd = (jnp.asarray(X) if self._sharding is None
+              else jax.device_put(X, self._sharding))
+        out = self._jit_for(kind, make_fn)(self.surrogate.params, Xd)
+        self._cache_keys.add((kind, bucket))
+        return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
+
+    def _query(self, kind, make_fn: Callable, X):
+        X = np.asarray(X, np.float32)
+        ndim = self.surrogate.ndim
+        if (X.ndim >= 2 and X.shape[-1] != ndim) \
+                or (X.ndim == 1 and X.size != ndim):
+            # a silent reshape would pair coordinates across row
+            # boundaries — reject mis-shaped matrices and flat
+            # multi-point arrays, keep the single-point [ndim] convenience
+            raise ValueError(
+                f"query has {X.shape[-1]} coordinate columns but this "
+                f"surrogate has {ndim} ({', '.join(self.surrogate.varnames)})")
+        X = X.reshape(-1, ndim)
+        top = self._buckets[-1]
+        chunks = [self._run(kind, make_fn, X[i:i + top])
+                  for i in range(0, max(X.shape[0], 1), top)]
+        if len(chunks) == 1:
+            return chunks[0]
+        return jax.tree_util.tree_map(
+            lambda *parts: np.concatenate(parts), *chunks)
+
+    # ------------------------------------------------------------------ #
+    def u(self, X) -> np.ndarray:
+        """Network evaluation ``u(X) -> [N, n_out]``."""
+        apply_fn = self.surrogate.apply_fn
+        return self._query("u", lambda: apply_fn, X)
+
+    def derivative(self, X, var: Union[str, int], order: int = 1,
+                   component: int = 0) -> np.ndarray:
+        """``order``-th derivative of output ``component`` along coordinate
+        ``var`` (name or index), batched: ``u_x = derivative(X, "x")``,
+        ``u_xx = derivative(X, "x", 2)``.  Returns ``[N]``."""
+        sur = self.surrogate
+        idx = var if isinstance(var, int) else sur.varnames.index(var)
+        if not 0 <= component < sur.n_out:
+            # validate eagerly: the scalar-output fast path below never
+            # consults UFn.__getitem__, which would otherwise catch this
+            raise ValueError(f"component {component} out of range for an "
+                             f"n_out={sur.n_out} surrogate")
+
+        def make():
+            def batched(params, Xb):
+                u = make_ufn(sur.apply_fn, params, sur.varnames, sur.n_out)
+                dfn = d(u if sur.n_out == 1 else u[component], idx, order)
+                return jax.vmap(
+                    lambda pt: dfn(*(pt[i] for i in range(sur.ndim))))(Xb)
+            return batched
+
+        return self._query(("d", idx, int(order), int(component)), make, X)
+
+    def residual(self, X):
+        """PDE residual ``f(X) -> [N]`` (tuple of ``[N]`` for systems),
+        via the generic per-point autodiff engine — the referee every
+        training engine is cross-checked against."""
+        sur = self.surrogate
+        point_res = sur.point_residual
+        if point_res is None:
+            raise ValueError(
+                "this surrogate has no f_model attached; pass f_model= to "
+                "Surrogate.load (or export from a compiled solver) to "
+                "enable residual queries")
+
+        def make():
+            def batched(params, Xb):
+                u = make_ufn(sur.apply_fn, params, sur.varnames, sur.n_out)
+                return vmap_residual(point_res, u, sur.ndim)(Xb)
+            return batched
+
+        return self._query("residual", make, X)
+
+    def predict(self, X):
+        """``(u, f)`` pair mirroring ``CollocationSolverND.predict`` (``f``
+        is ``None`` without an attached ``f_model``)."""
+        u = self.u(X)
+        if self.surrogate.point_residual is None:
+            return u, None
+        f = self.residual(X)
+        if isinstance(f, tuple) and len(f) == 1:
+            f = f[0]
+        return u, f

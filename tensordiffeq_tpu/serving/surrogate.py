@@ -1,0 +1,196 @@
+"""The deployable surrogate artifact — the train/infer split.
+
+A trained PINN's value is cheap downstream evaluation, but the training
+objects (:class:`~tensordiffeq_tpu.models.CollocationSolverND`,
+:class:`~tensordiffeq_tpu.models.DiscoveryModel`) drag the whole training
+state along: optimizer moments, SA λ, the collocation set, loss assembly.
+A :class:`Surrogate` is the inference-only extract — network + parameters +
+the ``u``/derivative/residual *closures* — and round-trips through the
+existing :mod:`tensordiffeq_tpu.checkpoint` backend so it restores in a
+fresh process with **no training state at all** (the saved state pytree is
+``{"params": ...}``, nothing else; PINNs-TF2, arXiv:2311.03626, identifies
+exactly this split as what makes PINN frameworks usable at scale).
+
+The residual ``f_model`` is user code and cannot be serialised — the same
+contract as the reference's ``AC-inference.py`` flow: the loader passes the
+(re-stated) ``f_model`` to :meth:`Surrogate.load` and the artifact re-binds
+it.  Discovery surrogates persist their learned coefficient *values* in the
+artifact metadata and re-bind them into the ``f_model(u, var, *coords)``
+signature automatically, so a restored discovery surrogate evaluates the
+*learned* PDE.
+
+Typical flow::
+
+    solver.fit(...)
+    solver.export_surrogate().save("runs/ac_surrogate")
+
+    # -- fresh process, no solver, no domain, no training state ----------
+    from tensordiffeq_tpu.serving import Surrogate
+    sur = Surrogate.load("runs/ac_surrogate", f_model=f_model)
+    engine = sur.engine()                 # batched, bucketed, jit-cached
+    u = engine.u(X)                       # [N, n_out]
+    u_x = engine.derivative(X, "x")       # [N]
+    f = engine.residual(X)                # [N] (tuple for systems)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint import (resolve_checkpoint_dir, restore_checkpoint,
+                          save_checkpoint)
+from ..networks import init_params, net_from_metadata, net_metadata
+
+_FORMAT = 1
+# which f_model signature the artifact's residual expects:
+#   forward    f_model(u, *coords)            (CollocationSolverND)
+#   discovery  f_model(u, var, *coords)       (DiscoveryModel; var = the
+#              learned coefficients, persisted in the artifact meta)
+_CONTRACTS = ("forward", "discovery")
+
+
+class Surrogate:
+    """Inference-only extract of a trained solver: net + params + closures.
+
+    Construct via :meth:`from_solver` / :meth:`from_discovery` (or the
+    solvers' ``export_surrogate()``), or :meth:`load` from a saved artifact.
+    Evaluation goes through :meth:`engine`, which adds shape bucketing,
+    compile-cache bounding, and optional query-axis sharding.
+    """
+
+    def __init__(self, net, params, varnames: Sequence[str], n_out: int = 1,
+                 f_model: Optional[Callable] = None,
+                 coefficients: Optional[Sequence] = None,
+                 contract: str = "forward"):
+        if contract not in _CONTRACTS:
+            raise ValueError(f"contract must be one of {_CONTRACTS}, "
+                             f"got {contract!r}")
+        self.net = net
+        self.params = params
+        self.varnames = tuple(varnames)
+        self.ndim = len(self.varnames)
+        self.n_out = int(n_out)
+        self.contract = contract
+        self.coefficients = (None if coefficients is None else
+                             [jnp.asarray(c, jnp.float32)
+                              for c in coefficients])
+        self.f_model = f_model
+        self.layer_sizes = list(getattr(net, "layer_sizes",
+                                        (self.ndim, self.n_out)))
+
+    # ------------------------------------------------------------------ #
+    @property
+    def apply_fn(self):
+        return self.net.apply
+
+    @property
+    def point_residual(self) -> Optional[Callable]:
+        """The per-point residual ``r(u, *coords)`` with any learned
+        coefficients bound in, or ``None`` when no ``f_model`` is attached
+        (u/derivative queries still work; residual queries raise)."""
+        if self.f_model is None:
+            return None
+        if self.contract == "discovery":
+            f, coeffs = self.f_model, self.coefficients
+            if coeffs is None:
+                raise ValueError(
+                    "discovery surrogate has no coefficient values; the "
+                    "artifact is corrupt or was built without vars")
+            return lambda u, *coords: f(u, coeffs, *coords)
+        return self.f_model
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_solver(cls, solver, best_model: bool = False) -> "Surrogate":
+        """Extract from a :class:`CollocationSolverND` (compiled, or
+        ``load_model``-restored).  ``best_model=True`` exports the best
+        iterate seen during training instead of the final one (the same
+        selection ``predict(best_model=True)`` uses)."""
+        params = solver.params
+        if best_model and solver.best_model.get("overall") is not None:
+            params = solver.best_model["overall"]
+        if getattr(solver, "_compiled", False):
+            varnames = tuple(solver.domain.vars)
+            f_model = solver.f_model
+        else:  # load_model-only solver: net exists, residual does not
+            varnames = tuple(f"x{i}"
+                             for i in range(int(solver.layer_sizes[0])))
+            f_model = None
+        return cls(solver.net, params, varnames, n_out=solver.n_out,
+                   f_model=f_model, contract="forward")
+
+    @classmethod
+    def from_discovery(cls, model) -> "Surrogate":
+        """Extract from a :class:`DiscoveryModel`: the learned coefficient
+        values are frozen into the artifact, so the surrogate evaluates the
+        *learned* PDE's residual."""
+        return cls(model.net, model.trainables["params"], model.varnames,
+                   n_out=model.n_out, f_model=model.f_model,
+                   coefficients=[np.asarray(v)
+                                 for v in model.trainables["vars"]],
+                   contract="discovery")
+
+    # ------------------------------------------------------------------ #
+    def engine(self, **kwargs):
+        """Build an :class:`~tensordiffeq_tpu.serving.InferenceEngine` over
+        this surrogate (see its docstring for bucketing/sharding knobs)."""
+        from .engine import InferenceEngine
+        return InferenceEngine(self, **kwargs)
+
+    # ------------------------------------------------------------------ #
+    def save(self, path: str) -> None:
+        """Persist under directory ``path`` via the checkpoint backend
+        (orbax primary, flax fallback, crash-safe swap).  The state pytree
+        is ``{"params": ...}`` only — by construction there is no optimizer
+        state, λ, or collocation set to leak into the artifact."""
+        meta = net_metadata(self.net, self.layer_sizes, self.n_out)
+        meta.update(surrogate_format=_FORMAT,
+                    varnames=list(self.varnames),
+                    contract=self.contract)
+        if self.coefficients is not None:
+            meta["coefficients"] = [np.asarray(c).tolist()
+                                    for c in self.coefficients]
+        save_checkpoint(path, {"params": self.params}, meta)
+
+    @classmethod
+    def load(cls, path: str, f_model: Optional[Callable] = None,
+             net=None) -> "Surrogate":
+        """Restore an artifact saved by :meth:`save` — needs no solver, no
+        domain, and no training state.  ``f_model`` re-attaches the residual
+        (user code is never serialised); omit it for u/derivative-only
+        serving.  For discovery artifacts pass the original
+        ``f_model(u, var, *coords)`` — the learned coefficients stored in
+        the artifact are re-bound automatically.  ``net`` re-attaches a
+        custom network module (also user code): required when the artifact
+        was exported from a ``compile(..., network=...)`` solver whose net
+        is not one of :data:`~tensordiffeq_tpu.networks.REBUILDABLE_NETS`;
+        it must be built with the same config the training run used."""
+        with open(os.path.join(resolve_checkpoint_dir(path),
+                               "tdq_meta.json")) as fh:
+            meta = json.load(fh)["meta"]
+        if "surrogate_format" not in meta:
+            raise ValueError(
+                f"{path} is not a surrogate artifact (it has no "
+                "surrogate_format field — a full training checkpoint "
+                "belongs to solver.restore_checkpoint)")
+        if net is None:
+            try:
+                net = net_from_metadata(meta)
+            except ValueError as e:
+                raise ValueError(
+                    f"{e}; here: Surrogate.load(path, f_model=..., "
+                    "net=<the network module the training run compiled "
+                    "with>)") from None
+        template = {"params": init_params(net, int(meta["layer_sizes"][0]),
+                                          jax.random.PRNGKey(0))}
+        state, _ = restore_checkpoint(path, template)
+        return cls(net, state["params"], meta["varnames"],
+                   n_out=int(meta["n_out"]), f_model=f_model,
+                   coefficients=meta.get("coefficients"),
+                   contract=meta.get("contract", "forward"))

@@ -32,6 +32,15 @@ import optax
 from ..utils import tree_copy
 from .progress import progress_bar
 
+# ``optax.tree`` is the >=0.2.4 alias of ``optax.tree_utils``; 0.2.3 (the
+# floor this repo supports) only ships the long name, and the two entry
+# points we use are spelled differently there (``tree_get``/``tree_l2_norm``)
+_optax_tree = getattr(optax, "tree", None)
+_tree_get = (_optax_tree.get if _optax_tree is not None
+             else optax.tree_utils.tree_get)
+_tree_norm = (_optax_tree.norm if _optax_tree is not None
+              else optax.tree_utils.tree_l2_norm)
+
 
 def _log_stop(msg: str) -> None:
     """Early-stop diagnostics go to stderr unconditionally: a silent stop
@@ -100,7 +109,7 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                 # track best at the iterate we just evaluated
                 new_value, x_at = value, x
             else:
-                new_value = optax.tree.get(state, "value")
+                new_value = _tree_get(state, "value")
                 x_at = x_new
             x = x_new
 
@@ -114,7 +123,7 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                 jnp.where(improved, new_value, f_best),
                 jnp.where(improved, it0 + i, i_best),
             )
-            gnorm = optax.tree.norm(grad)
+            gnorm = _tree_norm(grad)
             return (x, state, best), (new_value, gnorm)
 
         (x, state, best), (values, gnorms) = jax.lax.scan(

@@ -1,0 +1,350 @@
+"""Serving subsystem (tensordiffeq_tpu.serving): export/restore round-trip,
+pad-to-bucket determinism + compile-cache bounding, batcher flush policy,
+and derivative/residual agreement with the training-side engines.
+
+All CPU (conftest pins the 8-virtual-device backend), all tier-1 fast."""
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
+                              grad)
+from tensordiffeq_tpu.serving import RequestBatcher, Surrogate
+
+
+def make_solver(n_f=128, seed=0, fused=False):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(n_f, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=seed)
+    s.compile([2, 8, 8, 1], f_model, domain, bcs, fused=fused)
+    return s, f_model
+
+
+def query_points(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.uniform(-1, 1, n),
+                     rng.uniform(0, 1, n)], -1).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# export -> query: bit-identity with the solver's own inference path
+# --------------------------------------------------------------------------- #
+def test_engine_matches_predict_bit_identically():
+    """The bit-identity contract: ``u`` matches ``solver.predict`` exactly
+    at EVERY query size (the MLP forward is row-stable under batch-shape
+    change on this backend), and every query kind — residual included —
+    matches ``solver.predict`` exactly when evaluated at the engine's own
+    padded chunk shapes (same shape -> same XLA program -> same bits; at a
+    non-bucket size the solver's exact-shape residual compile can differ
+    from the bucket-shape compile by 1 ulp in the autodiff chain)."""
+    s, _ = make_solver(fused=False)  # generic engine on both sides
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=256)
+
+    for n in (17, 64, 100, 300):  # pad, exact-bucket, and chunked cases
+        X = query_points(n, seed=n)
+        u_ref, _ = s.predict(X)
+        assert np.array_equal(eng.u(X), u_ref), f"u differs at n={n}"
+        # reference residual from predict at the engine's padded shapes
+        parts = []
+        for i in range(0, n, 256):
+            chunk = X[i:i + 256]
+            m, b = chunk.shape[0], eng.bucket_for(chunk.shape[0])
+            Xp = (np.concatenate([chunk, np.zeros((b - m, 2), np.float32)])
+                  if m < b else chunk)
+            parts.append(s.predict(Xp)[1][:m])
+        assert np.array_equal(eng.residual(X), np.concatenate(parts)), \
+            f"f differs at n={n}"
+
+    # exact-bucket query: no padding on either side, everything bit-equal
+    X = query_points(64, seed=64)
+    u, f = eng.predict(X)
+    u_ref, f_ref = s.predict(X)
+    assert np.array_equal(u, u_ref) and np.array_equal(f, f_ref)
+
+
+def test_best_model_export_matches_predict_best():
+    s, _ = make_solver(fused=False)
+    s.fit(tf_iter=10, newton_iter=0, chunk=5)
+    X = query_points(40)
+    u_best, _ = s.predict(X, best_model=True)
+    eng = s.export_surrogate(best_model=True).engine(min_bucket=64)
+    assert np.array_equal(eng.u(X), u_best)
+
+
+# --------------------------------------------------------------------------- #
+# save -> fresh restore: no training state in the artifact
+# --------------------------------------------------------------------------- #
+def test_save_load_roundtrip_matches(tmp_path):
+    s, f_model = make_solver(fused=False)
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s.export_surrogate().save(str(tmp_path / "art"))
+
+    sur = Surrogate.load(str(tmp_path / "art"), f_model=f_model)
+    assert sur.varnames == ("x", "t")
+    X = query_points(90)
+    u_ref, f_ref = s.predict(X)
+    eng = sur.engine(min_bucket=64)
+    assert np.array_equal(eng.u(X), u_ref)
+    assert np.array_equal(eng.residual(X), f_ref)
+
+
+def test_artifact_state_is_params_only(tmp_path):
+    import json
+    import os
+
+    s, _ = make_solver()
+    s.export_surrogate().save(str(tmp_path / "art"))
+    from tensordiffeq_tpu.checkpoint import resolve_checkpoint_dir
+    d = resolve_checkpoint_dir(str(tmp_path / "art"))
+    with open(os.path.join(d, "tdq_meta.json")) as fh:
+        meta = json.load(fh)["meta"]
+    assert meta["surrogate_format"] == 1
+    # restore through the raw checkpoint API: the pytree must hold params
+    # and nothing else (no opt_state, no lambdas, no collocation set)
+    sur = Surrogate.load(str(tmp_path / "art"))
+    assert sur.f_model is None and sur.coefficients is None
+
+
+def test_load_without_f_model_serves_u_but_not_residual(tmp_path):
+    s, _ = make_solver()
+    s.export_surrogate().save(str(tmp_path / "art"))
+    eng = Surrogate.load(str(tmp_path / "art")).engine(min_bucket=64)
+    assert eng.u(query_points(8)).shape == (8, 1)
+    with pytest.raises(ValueError, match="f_model"):
+        eng.residual(query_points(8))
+    u, f = eng.predict(query_points(8))
+    assert f is None
+
+
+def test_full_training_checkpoint_rejected(tmp_path):
+    s, _ = make_solver()
+    s.fit(tf_iter=2, newton_iter=0, chunk=2)
+    s.save_checkpoint(str(tmp_path / "full_ck"))
+    with pytest.raises(ValueError, match="not a surrogate artifact"):
+        Surrogate.load(str(tmp_path / "full_ck"))
+
+
+# --------------------------------------------------------------------------- #
+# bucketing: deterministic padding, bounded compile cache
+# --------------------------------------------------------------------------- #
+def test_bucket_ladder_and_mapping():
+    s, _ = make_solver()
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=512)
+    assert eng.bucket_sizes == (64, 128, 256, 512)
+    assert eng.n_buckets == 4
+    for n, want in ((1, 64), (64, 64), (65, 128), (128, 128),
+                    (129, 256), (512, 512), (10_000, 512)):
+        assert eng.bucket_for(n) == want, f"bucket_for({n})"
+
+
+def test_non_pow2_buckets_rejected():
+    s, _ = make_solver()
+    sur = s.export_surrogate()
+    with pytest.raises(ValueError, match="powers of two"):
+        sur.engine(min_bucket=100)
+    with pytest.raises(ValueError, match="powers of two"):
+        sur.engine(max_bucket=1000)
+    with pytest.raises(ValueError, match="min_bucket"):
+        sur.engine(min_bucket=512, max_bucket=256)
+
+
+def test_compile_cache_bounded_under_randomized_shapes():
+    s, _ = make_solver()
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=256)
+    rng = np.random.RandomState(7)
+    for n in rng.randint(1, 700, size=40):  # crosses every bucket + chunking
+        eng.u(query_points(int(n), seed=int(n)))
+    assert eng.compile_cache_size <= eng.n_buckets
+    eng.residual(query_points(10))
+    eng.derivative(query_points(10), "x")
+    # three kinds used -> at most 3 * n_buckets programs, ever
+    assert eng.compile_cache_size <= 3 * eng.n_buckets
+
+
+def test_padding_is_deterministic_and_row_stable():
+    s, _ = make_solver()
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=128)
+    X = query_points(100)
+    a, b = eng.u(X), eng.u(X)
+    assert np.array_equal(a, b)
+    # a prefix of the batch evaluates identically on its own, even though
+    # 30 pads to the 64 bucket and 100 to the 128 bucket
+    assert np.array_equal(eng.u(X[:30]), a[:30])
+
+
+# --------------------------------------------------------------------------- #
+# derivative / residual queries vs the training-side engines
+# --------------------------------------------------------------------------- #
+def test_derivatives_recombine_into_residual():
+    s, _ = make_solver(fused=False)
+    eng = s.export_surrogate().engine(min_bucket=64)
+    X = query_points(50)
+    u = eng.u(X)[:, 0]
+    u_t = eng.derivative(X, "t")
+    u_x = eng.derivative(X, "x")
+    u_xx = eng.derivative(X, "x", order=2)
+    np.testing.assert_allclose(u_t + u * u_x - 0.01 * u_xx,
+                               eng.residual(X), rtol=1e-5, atol=1e-6)
+
+
+def test_residual_matches_fused_training_engine():
+    s, _ = make_solver(fused=None)  # auto: fused Taylor engine when able
+    eng = s.export_surrogate().engine(min_bucket=64)
+    X = query_points(60)
+    _, f_train = s.predict(X)  # training-side (possibly fused) residual
+    np.testing.assert_allclose(eng.residual(X), f_train,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_discovery_export_binds_learned_coefficients(tmp_path):
+    from tensordiffeq_tpu import DiscoveryModel
+
+    def f_model(u, var, x, t):
+        c1, c2 = var
+        u_xx = grad(grad(u, "x"), "x")
+        return grad(u, "t")(x, t) - c1 * u_xx(x, t) + c2 * u(x, t)
+
+    X = query_points(64)
+    u_star = np.tanh(X[:, :1])
+    m = DiscoveryModel()
+    m.compile([2, 8, 8, 1], f_model, [X[:, 0:1], X[:, 1:2]], u_star,
+              var=[0.3, -1.2], varnames=["x", "t"], verbose=False)
+    m.export_surrogate().save(str(tmp_path / "disc"))
+
+    sur = Surrogate.load(str(tmp_path / "disc"), f_model=f_model)
+    np.testing.assert_allclose(
+        np.asarray(sur.coefficients), [0.3, -1.2], atol=1e-7)
+    eng = sur.engine(min_bucket=64)
+    np.testing.assert_allclose(eng.u(X), m.predict(X), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(eng.residual(X),
+                               np.asarray(m.predict_f(X)).ravel(),
+                               rtol=1e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# batcher: max-batch and deadline flushes
+# --------------------------------------------------------------------------- #
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_batcher(max_batch=8, max_latency_s=0.5):
+    calls = []
+
+    def op(X):
+        calls.append(X.shape[0])
+        return X[:, :1] * 2.0
+
+    clock = FakeClock()
+    b = RequestBatcher(op=op, max_batch=max_batch,
+                       max_latency_s=max_latency_s, clock=clock)
+    return b, calls, clock
+
+
+def test_batcher_flushes_on_max_batch():
+    b, calls, _ = make_batcher(max_batch=8)
+    h1 = b.submit(query_points(3))
+    h2 = b.submit(query_points(4))
+    assert not calls and not h1.done and b.pending_points == 7
+    h3 = b.submit(query_points(2))  # 9 >= 8: inline flush
+    assert calls == [9]
+    assert h1.done and h2.done and h3.done
+    assert h1.result().shape == (3, 1) and h3.result().shape == (2, 1)
+
+
+def test_batcher_flushes_on_deadline():
+    b, calls, clock = make_batcher(max_latency_s=0.5)
+    b.submit(query_points(1))
+    clock.t = 0.4
+    assert not b.poll() and not calls  # deadline not reached
+    clock.t = 0.51
+    assert b.poll()
+    assert calls == [1]
+    assert not b.poll()  # nothing pending anymore
+
+
+def test_batcher_result_forces_flush_and_slices_correctly():
+    b, calls, _ = make_batcher(max_batch=100)
+    X1, X2 = query_points(3, seed=1), query_points(5, seed=2)
+    h1, h2 = b.submit(X1), b.submit(X2)
+    out2 = h2.result()  # blocking result stands in for the deadline
+    assert calls == [8]
+    np.testing.assert_allclose(out2, X2[:, :1] * 2.0)
+    np.testing.assert_allclose(h1.result(), X1[:, :1] * 2.0)
+
+
+def test_batcher_stats_report_qps_and_percentiles():
+    b, _, clock = make_batcher(max_batch=4)
+    for _ in range(6):  # two flushes of 4 and 2 points
+        b.submit(query_points(1))
+        clock.t += 0.01
+    b.flush()
+    s = b.stats()
+    assert s["requests"] == 6 and s["batches"] == 2 and s["points"] == 6
+    assert s["qps"] is not None and s["qps"] > 0
+    assert set(s["latency_s"]) == {"p50", "p90", "p99"}
+    assert all(v is not None for v in s["latency_s"].values())
+
+
+def test_batcher_tuple_results_for_systems():
+    def op(X):
+        return (X[:, 0], X[:, 1])  # two-equation residual shape
+
+    b = RequestBatcher(op=op, max_batch=100)
+    h = b.submit(query_points(4))
+    b.flush()
+    f1, f2 = h.result()
+    assert f1.shape == (4,) and f2.shape == (4,)
+
+
+def test_batcher_requires_engine_or_op():
+    with pytest.raises(ValueError, match="engine or an explicit op"):
+        RequestBatcher()
+
+
+def test_batcher_op_failure_reaches_every_waiter():
+    """A flush whose op raises must deliver the exception to EVERY
+    coalesced handle (result() re-raises), not just the flush caller —
+    and the failed requests must not be counted as served."""
+    def op(X):
+        raise RuntimeError("device fell over")
+
+    b = RequestBatcher(op=op, max_batch=100)
+    h1, h2 = b.submit(query_points(2)), b.submit(query_points(3))
+    with pytest.raises(RuntimeError, match="device fell over"):
+        b.flush()
+    assert h1.done and h2.done
+    for h in (h1, h2):
+        with pytest.raises(RuntimeError, match="device fell over"):
+            h.result()
+    s = b.stats()
+    assert s["requests"] == 0 and s["failed"] == 2
+
+
+def test_engine_rejects_wrong_coordinate_width():
+    """A [N, 3] query against a 2-coordinate surrogate must raise, not be
+    silently reshaped into garbage rows."""
+    s, _ = make_solver()
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=256)
+    with pytest.raises(ValueError, match="3 coordinate columns"):
+        eng.u(np.zeros((4, 3), np.float32))
+    # a flat length-k*ndim array is ambiguous, not k points
+    with pytest.raises(ValueError, match="coordinate columns"):
+        eng.u(np.zeros(4, np.float32))
+    # the single-point [ndim] convenience still works
+    assert eng.u(np.zeros(2, np.float32)).shape == (1, 1)

@@ -284,6 +284,34 @@ def compiled_flops(compiled):
         return None
 
 
+def _record_step_split(n_steps, dispatch_s, device_s):
+    """Record the fenced dispatch/device per-step split of a timed loop in
+    the shared telemetry registry (phase=bench), so every mode's payload
+    can embed a step-time breakdown (see bench_telemetry_block)."""
+    try:
+        from tensordiffeq_tpu import telemetry
+    except Exception:
+        return
+    scope = telemetry.default_registry().scope(phase="bench")
+    n = max(int(n_steps), 1)
+    scope.histogram("step_time_dispatch_s").observe(dispatch_s / n)
+    scope.histogram("step_time_device_s").observe(device_s / n)
+
+
+def bench_telemetry_block():
+    """The ``telemetry`` block embedded in every live worker payload:
+    step-time breakdown (phase=bench loops and, under --full, the
+    trainer's adam/l-bfgs phases), device memory peak, and the full
+    shared-registry snapshot (serving compile/pad-waste/queue metrics in
+    --serving mode)."""
+    from tensordiffeq_tpu import profiling, telemetry
+    reg = telemetry.default_registry().as_dict()
+    peak = profiling.device_memory_peak()
+    step = {k: v for k, v in reg.get("histograms", {}).items()
+            if k.startswith("step_time")}
+    return {"memory_peak_bytes": peak, "step_time": step, "metrics": reg}
+
+
 def _analytic_step_floor(n_f, widths):
     """Lower bound on model FLOPs for one SA train step: forward + backward
     over the collocation batch alone (``2*sum(d_i*d_{i+1})`` MACs per point
@@ -464,8 +492,10 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune",
     t0 = time.time()
     for _ in range(n_steps):
         trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
+    t_dispatched = time.time()
     jax.block_until_ready(loss)
     dt = time.time() - t0
+    _record_step_split(n_steps, t_dispatched - t0, dt - (t_dispatched - t0))
     # build_solver never passes dist=True: the jitted step runs on the one
     # default device however many the host exposes, so per-chip == measured
     n_chips = 1
@@ -617,8 +647,10 @@ def bench_engines(n_f, nx, nt, widths, n_steps):
             for _ in range(n_steps):
                 trainables, opt_state, loss = step(trainables, opt_state,
                                                    solver.X_f)
+            t_disp = time.time()
             jax.block_until_ready(loss)
             dt = time.time() - t0
+            _record_step_split(n_steps, t_disp - t0, dt - (t_disp - t0))
             pts = n_f * n_steps / dt / n_chips
             results[engine] = pts
             log(f"[engines] {engine}: compile {compile_t:.1f}s, "
@@ -675,8 +707,10 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
             for _ in range(n_steps):
                 trainables, opt_state, loss = step(trainables, opt_state,
                                                    solver.X_f)
+            t_disp = time.time()
             jax.block_until_ready(loss)
             dt = time.time() - t0
+            _record_step_split(n_steps, t_disp - t0, dt - (t_disp - t0))
             loss = float(loss)
             if name == "f32-highest":
                 ref_loss = loss
@@ -1047,10 +1081,19 @@ def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
                      "engine": engine_used, "windows": windows,
                      "timeline": list(timeline)})
 
+    # metrics-only telemetry (no JSONL, no raise, and grad_norm=False so
+    # the compiled step stays bit-identical to earlier captures of this
+    # headline): the trainer's fenced adam/l-bfgs step-time split rides
+    # into the payload's telemetry block; a NaN here must surface through
+    # the artifact, not kill the capture
+    from tensordiffeq_tpu.telemetry import TrainingTelemetry
     solver.fit(tf_iter=adam_iter - adam_done,
                newton_iter=newton_iter - newton_done,
                eval_fn=eval_fn, eval_every=eval_every,
-               checkpoint_dir=(ckpt or None), checkpoint_every=eval_every)
+               checkpoint_dir=(ckpt or None), checkpoint_every=eval_every,
+               telemetry=TrainingTelemetry(logger=None, log_every=0,
+                                           raise_on_divergence=False,
+                                           grad_norm=False))
     wall = t_prev + time.time() - t0
     u_pred, _ = solver.predict(Xg, best_model=True)
     l2_best = float(find_L2_error(u_pred, u_star))
@@ -1232,6 +1275,10 @@ def worker_main(args):
     payload.setdefault("backend", jax.default_backend())
     payload.setdefault("device_kind", jax.devices()[0].device_kind)
     payload.setdefault("captured", time.strftime("%Y-%m-%d"))
+    try:
+        payload.setdefault("telemetry", bench_telemetry_block())
+    except Exception as e:  # observability must never cost a measurement
+        log(f"[telemetry] snapshot failed: {type(e).__name__}: {e}")
     print(json.dumps(payload), flush=True)
 
 

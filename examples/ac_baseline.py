@@ -82,15 +82,33 @@ def evaluate(solver, args, name):
 
 
 def main():
-    args = example_args("Allen-Cahn baseline forward PINN")
+    args = example_args(
+        "Allen-Cahn baseline forward PINN",
+        telemetry=("", "write a JSONL telemetry run log under this "
+                       "directory and print telemetry.report() at the end"))
     n_f = scaled(args, 50_000, 2_000)
     domain, bcs, f_model = build_problem(n_f, nx=512 if not args.quick else 64,
                                          nt=201 if not args.quick else 21)
     widths = [128] * 4 if not args.quick else [32] * 2
     solver = CollocationSolverND()
     solver.compile([2, *widths, 1], f_model, domain, bcs)
-    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100))
+    tf_iter = scaled(args, 10_000, 200)
+    newton_iter = scaled(args, 10_000, 100)
+    if args.telemetry:
+        # subscribe instead of scraping stdout: the run's config, per-epoch
+        # losses/grad-norm, step-time split, and any divergence land in
+        # <dir>/events.jsonl, and the report renders the diagnosis
+        with tdq.telemetry.RunLogger(
+                args.telemetry,
+                config={"example": "ac_baseline", "n_f": n_f,
+                        "tf_iter": tf_iter, "newton_iter": newton_iter,
+                        "widths": widths}) as run:
+            fit_resumable(solver, quick=args.quick, tf_iter=tf_iter,
+                          newton_iter=newton_iter, telemetry=run)
+        print(tdq.telemetry.report(args.telemetry))
+    else:
+        fit_resumable(solver, quick=args.quick, tf_iter=tf_iter,
+                      newton_iter=newton_iter)
     return evaluate(solver, args, "ac_baseline")
 
 

@@ -138,6 +138,26 @@ def main():
     t0 = time.time()
     Xg_j = None
 
+    # telemetry subscription (PR 4): the run's config, sampled per-epoch
+    # losses/grad-norm, step-time split, λ stats, and any divergence land
+    # in runs/ns_telemetry*/events.jsonl — structured, resumable-appendable
+    # — instead of being scraped off this script's stderr.  Metrics-only
+    # raise policy: a NaN must surface through the artifact/report, not
+    # kill a tunnel window mid-leg.
+    import atexit
+
+    from tensordiffeq_tpu import telemetry as tdq_telemetry
+    ns_run = tdq_telemetry.RunLogger(
+        os.path.join(REPO, "runs", f"ns_telemetry{_SFX}"),
+        config={"n_f": N_F, "widths": WIDTHS, "periodic": PERIODIC,
+                "target": TARGET, "window": meta["windows"]})
+    atexit.register(ns_run.close)
+    ns_tele = tdq_telemetry.TrainingTelemetry(
+        logger=ns_run, log_every=EVAL_EVERY, raise_on_divergence=False,
+        grad_norm=False)  # the run IS the headline measurement: keep the
+    # compiled step bit-identical to pre-telemetry captures (no per-step
+    # global-norm reduction skewing t_target)
+
     def now():
         # CUMULATIVE productive time across windows — reporting only
         # (timelines, t_target, persisted meta); never a budget gate
@@ -199,7 +219,8 @@ def main():
             persist("partial")
 
         solver.fit(tf_iter=n, eval_fn=eval_fn, eval_every=EVAL_EVERY,
-                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY)
+                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY,
+                   telemetry=ns_tele)
         meta["adam_done"] = a0 + n
         meta["legs"].append({"kind": "adam", "n": n, "t": round(now(), 1)})
 
@@ -213,7 +234,8 @@ def main():
         before = eval_l2()
         solver.fit(newton_iter=n, newton_eager=eager,
                    eval_fn=eval_fn, eval_every=EVAL_EVERY,
-                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY)
+                   checkpoint_dir=CKPT, checkpoint_every=EVAL_EVERY,
+                   telemetry=ns_tele)
         # how far did it actually get?  fit credits actual iterations
         ran = solver.newton_done - n0 if hasattr(solver, "newton_done") else n
         meta["newton_done"] = n0 + max(int(ran), 0)

@@ -30,7 +30,8 @@ Quick start (Burgers)::
 
 from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
-from . import parallel, plotting, profiling, sampling, training, utils  # noqa: F401
+from . import parallel, plotting, profiling, sampling, telemetry  # noqa: F401
+from . import training, utils  # noqa: F401
 from . import models, serving  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
@@ -42,5 +43,7 @@ from .networks import (MLP, FourierMLP, PeriodicMLP, fourier_net,  # noqa: F401
 from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
 from .serving import InferenceEngine, RequestBatcher, Surrogate  # noqa: F401
+from .telemetry import (MetricsRegistry, RunLogger,  # noqa: F401
+                        TrainingDiverged, TrainingTelemetry)
 
 __version__ = "0.3.0"  # kept in sync with pyproject.toml

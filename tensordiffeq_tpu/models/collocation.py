@@ -33,6 +33,7 @@ from ..domains import DomainND
 from ..networks import neural_net
 from ..ops.derivatives import make_ufn, vmap_residual
 from ..output import print_screen
+from ..telemetry import as_training_telemetry, log_event
 from ..training.fit import (FitResult, fit_adam, make_optimizer,
                             opt_state_matches)
 from ..utils import initialize_lambdas, tree_copy
@@ -385,9 +386,11 @@ class CollocationSolverND:
                         n_check=n_chk, residual_fn=pallas_res)
                     if ok:
                         candidates[f"pallas-{tile}"] = pallas_res
-                    elif self.verbose:
-                        print(f"[autotune] pallas tile={tile} excluded "
-                              f"({type(reason).__name__}: {reason})")
+                    else:
+                        log_event("autotune",
+                                  f"pallas tile={tile} excluded "
+                                  f"({type(reason).__name__}: {reason})",
+                                  verbose=self.verbose)
         timings = {}
         failures = {}
         for name, res_fn in candidates.items():
@@ -420,12 +423,12 @@ class CollocationSolverND:
                 + "; ".join(f"{k}: {type(e).__name__}: {e}"
                             for k, e in failures.items()))
         best = min(timings, key=timings.get)
-        if self.verbose:
-            shown = ", ".join(f"{k}={v * 1e3:.2f}ms"
-                              for k, v in timings.items())
-            for k, e in failures.items():
-                shown += f", {k}=FAILED({type(e).__name__})"
-            print(f"[autotune] residual engine: {best} ({shown})")
+        shown = ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in timings.items())
+        for k, e in failures.items():
+            shown += f", {k}=FAILED({type(e).__name__})"
+        log_event("autotune", f"residual engine: {best} ({shown})",
+                  verbose=self.verbose, engine=best,
+                  timings_ms={k: v * 1e3 for k, v in timings.items()})
         return candidates[best]
 
     def _assemble_losses(self):
@@ -559,20 +562,21 @@ class CollocationSolverND:
                         "against the generic engine") from reason
                 self._fuse_fail_reason = reason
                 self._fused_residual = None
-                if self.verbose:
-                    print(f"[fuse] cross-check failed "
+                log_event("fuse", f"cross-check failed "
                           f"({type(reason).__name__}: {reason}); using the "
-                          "generic autodiff engine")
+                          "generic autodiff engine", verbose=self.verbose,
+                          level="warning")
         if self.fused == "autotune":
             if self._fused_residual is not None:
                 self._fused_residual = self._autotune_engine()
-            elif self.verbose:
+            else:
                 reason = getattr(self, "_fuse_fail_reason", None)
                 why = (f"{type(reason).__name__}: {reason}"
                        if reason is not None else "network is not the "
                        "standard float32 tanh MLP")
-                print(f"[autotune] fused engine excluded ({why}); only the "
-                      "generic engine was considered")
+                log_event("autotune", f"fused engine excluded ({why}); "
+                          "only the generic engine was considered",
+                          verbose=self.verbose)
         if self.fused_dtype is not None and self._fused_residual is None:
             # the docstring promises "ignored with a warning" — honor it on
             # the silent-fallback path too (fused=None/'autotune' whose
@@ -674,7 +678,8 @@ class CollocationSolverND:
             resample_temp: float = 1.0, resample_uniform: float = 0.1,
             resample_seed: int = 0,
             checkpoint_dir: Optional[str] = None,
-            checkpoint_every: int = 0):
+            checkpoint_every: int = 0,
+            telemetry=None):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
         ``fit.py:17-102``).
 
@@ -715,7 +720,19 @@ class CollocationSolverND:
         Shapes and sharding are preserved, so the compiled step and Adam
         moments carry on; the L-BFGS phase refines on the final redraw.
         Incompatible with per-point residual λ (Adaptive_type=1), whose rows
-        are trained state aligned to their points — the solver raises."""
+        are trained state aligned to their points — the solver raises.
+
+        ``telemetry`` (beyond-reference;
+        :mod:`tensordiffeq_tpu.telemetry`): a
+        :class:`~tensordiffeq_tpu.telemetry.TrainingTelemetry` subscriber
+        or a bare :class:`~tensordiffeq_tpu.telemetry.RunLogger` (wrapped
+        with defaults).  The run then emits structured events — config,
+        per-epoch loss components + gradient global-norm, SA-λ
+        distribution summaries, step-time breakdown, checkpoint writes —
+        and the NaN/Inf sentinel raises a structured
+        :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged` instead of
+        letting a poisoned history run to the end.  Render the resulting
+        run directory with :func:`tensordiffeq_tpu.telemetry.report`."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before fit(...)")
         if profile_dir is not None:
@@ -731,7 +748,22 @@ class CollocationSolverND:
                                 resample_pool=resample_pool,
                                 resample_temp=resample_temp,
                                 resample_uniform=resample_uniform,
-                                resample_seed=resample_seed)
+                                resample_seed=resample_seed,
+                                telemetry=telemetry)
+        tele = as_training_telemetry(telemetry)
+        epochs_at_entry = len(self.losses)
+        if tele is not None:
+            tele.on_fit_start(dict(
+                tf_iter=tf_iter, newton_iter=newton_iter, batch_sz=batch_sz,
+                N_f=int(self.X_f.shape[0]),
+                layer_sizes=list(self.layer_sizes),
+                Adaptive_type=self.Adaptive_type, dist=self.dist,
+                engine=("fused" if self._fused_residual is not None
+                        else "generic"),
+                resample_every=resample_every,
+                causal_ladder=list(getattr(self, "causal_ladder", []) or []),
+                prior_epochs=epochs_at_entry,
+                prior_newton=int(getattr(self, "newton_done", 0))))
         if self.verbose:
             print_screen(self)
 
@@ -845,6 +877,13 @@ class CollocationSolverND:
                     meta.update(has_best=True, best_phase=ph,
                                 best_loss=bl, best_iter=bi)
                 _save_ck(checkpoint_dir, state, meta)
+                if tele is not None:
+                    # epoch arrives stage-rebased; add the restored history
+                    # so the event is absolute (L-BFGS: newton_done already is)
+                    tele.on_checkpoint(phase,
+                                       int(newton_done)
+                                       if phase == "l-bfgs"
+                                       else epoch + epochs_at_entry)
 
         result = FitResult()
         result.losses = self.losses
@@ -891,13 +930,15 @@ class CollocationSolverND:
             stage_off = 0  # epochs consumed by earlier stages THIS fit call
             for si, eps in enumerate(stages):
                 if eps is not None and eps != self.causal_eps:
-                    if self.verbose:
-                        if si == 0:
-                            print(f"[causal] ladder restart: ε -> {eps:g}")
-                        else:
-                            print(f"[causal] gate open (w_last > "
+                    if si == 0:
+                        log_event("causal", f"ladder restart: ε -> {eps:g}",
+                                  verbose=self.verbose, eps=eps)
+                    else:
+                        log_event("causal", f"gate open (w_last > "
                                   f"{self.causal_delta:g}); ε -> {eps:g} "
-                                  f"({remaining} Adam epochs left)")
+                                  f"({remaining} Adam epochs left)",
+                                  verbose=self.verbose, eps=eps,
+                                  remaining=remaining)
                     self._set_causal_eps(eps)
                 stop_fn = None
                 if si < len(stages) - 1:
@@ -927,6 +968,10 @@ class CollocationSolverND:
                         if best is not None:
                             best = (best[0], best[1], int(best[2]) + _o)
                         ckpt_hook(tr, st, e + _o, best=best, **kw)
+                if tele is not None:
+                    # telemetry epochs are run-relative: restored history
+                    # plus the epochs earlier ε stages consumed this call
+                    tele.epoch_offset = epochs_at_entry + off
                 trainables, self.opt_state, result = fit_adam(
                     self.loss_fn, self.params, lambdas, X_f,
                     tf_iter=remaining, batch_sz=batch_sz, lr=self.lr,
@@ -940,7 +985,7 @@ class CollocationSolverND:
                     resample_fn=res_fn,
                     resample_every=resample_every,
                     state_hook=hook, state_hook_every=checkpoint_every,
-                    stop_fn=stop_fn)
+                    stop_fn=stop_fn, telemetry=tele)
                 self.params = trainables["params"]
                 self.lambdas = lambdas = trainables["lambdas"]
                 result.wall_time["adam"] += wall_before
@@ -1010,9 +1055,17 @@ class CollocationSolverND:
                 maxiter=newton_iter, verbose=self.verbose,
                 eager=bool(newton_eager),
                 callback=(lb_callback if lb_every > 0 else None),
-                callback_every=lb_every)
+                callback_every=lb_every, telemetry=tele)
             self.params = params
             self.losses.extend(lbfgs_losses)
+            if tele is not None:
+                # iteration numbers are absolute refinement progress; a
+                # NaN stop logs a divergence event (no raise — the loop
+                # already stopped itself and kept its best iterate)
+                tele.epoch_offset = 0
+                tele.on_lbfgs_history(
+                    [d["Total Loss"] for d in lbfgs_losses],
+                    start_iter=newton_prior)
             # same adopt-if-better rule as the Adam phase: a resumed
             # refinement leg keeps the restored best when that's better
             if (self.best_model["l-bfgs"] is None
@@ -1041,6 +1094,13 @@ class CollocationSolverND:
         self.min_loss["overall"] = self.min_loss[which]
         self.best_epoch["overall"] = self.best_epoch[which] + offset
         self.best_model["overall"] = self.best_model[which]
+        if tele is not None:
+            tele.on_fit_end(dict(
+                epochs_total=len(self.losses),
+                newton_done=int(getattr(self, "newton_done", 0)),
+                min_loss={k: float(v) for k, v in self.min_loss.items()},
+                best_epoch={k: int(v) for k, v in self.best_epoch.items()},
+                wall_adam=float(result.wall_time.get("adam", 0.0))))
         return self
 
     # ------------------------------------------------------------------ #
@@ -1112,6 +1172,10 @@ class CollocationSolverND:
             meta.update(has_best=True, best_phase=ph, best_loss=bl,
                         best_iter=int(self.best_epoch.get(ph, -1)))
         save_checkpoint(path, state, meta)
+        log_event("checkpoint", f"saved full training state -> {path}",
+                  verbose=False, path=str(path),
+                  epochs=len(self.losses),
+                  newton_done=int(getattr(self, "newton_done", 0)))
 
     def restore_checkpoint(self, path: str):
         """Restore a :meth:`save_checkpoint` state into this (compiled)
@@ -1178,6 +1242,10 @@ class CollocationSolverND:
         # taken (0 for Adam-phase checkpoints) — resume helpers subtract
         # it from the refinement budget
         self.newton_done = int(meta.get("newton_done", 0))
+        log_event("restore", f"restored training state from {path} "
+                  f"({len(self.losses)} epochs, {self.newton_done} L-BFGS "
+                  "iters on record)", verbose=False, path=str(path),
+                  epochs=len(self.losses), newton_done=self.newton_done)
         return self
 
     # ------------------------------------------------------------------ #

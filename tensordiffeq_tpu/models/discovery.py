@@ -182,9 +182,10 @@ class DiscoveryModel:
         n_dev = int(np.prod(mesh.devices.shape))
         n = int(self.X.shape[0])
         keep = n - n % n_dev
-        if keep != n and self.verbose:
-            print(f"[discovery] trimming observations {n} -> {keep} to tile "
-                  f"{n_dev} devices")
+        if keep != n:
+            from ..telemetry import log_event
+            log_event("discovery", f"trimming observations {n} -> {keep} "
+                      f"to tile {n_dev} devices", verbose=self.verbose)
         self.X = jax.device_put(self.X[:keep], data_sharding(mesh, 2))
         self.u_data = jax.device_put(self.u_data[:keep],
                                      data_sharding(mesh, 2))
@@ -277,10 +278,10 @@ class DiscoveryModel:
                         "cross-check") from reason
                 self._fuse_fail_reason = reason
                 self._fused_residual = None
-                if self.verbose:
-                    print(f"[fuse] discovery cross-check failed "
+                from ..telemetry import log_event
+                log_event("fuse", f"discovery cross-check failed "
                           f"({type(reason).__name__}); using the generic "
-                          "engine")
+                          "engine", verbose=self.verbose, level="warning")
         fused_res = self._fused_residual
 
         # minibatching (round 4): the reference trains the inverse problem

@@ -74,8 +74,9 @@ def load():
             _lib = lib
         except (OSError, subprocess.CalledProcessError) as e:
             _load_failed = True
-            print(f"[tdq.native] C++ ESE unavailable ({e}); "
-                  "using NumPy fallback")
+            from ..telemetry import log_event
+            log_event("tdq.native", f"C++ ESE unavailable ({e}); "
+                      "using NumPy fallback", level="warning")
     return _lib
 
 

@@ -16,11 +16,17 @@ _BANNER = r"""
 
 
 def print_screen(solver, discovery_model: bool = False):
-    """Print the banner, device inventory and parameter count."""
-    print(_BANNER)
+    """Print the banner, device inventory and parameter count (and log
+    the structured equivalent to any active telemetry run sink)."""
+    from .telemetry import log_event
     devices = jax.devices()
-    print(f"Backend: {devices[0].platform} | devices: {len(devices)}")
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(solver.params))
     kind = "DiscoveryModel" if discovery_model else type(solver).__name__
-    print(f"{kind}: layer_sizes={getattr(solver, 'layer_sizes', '?')} "
-          f"({n_params:,} parameters)")
+    layer_sizes = getattr(solver, "layer_sizes", "?")
+    log_event(
+        "banner",
+        f"{_BANNER}\nBackend: {devices[0].platform} | devices: "
+        f"{len(devices)}\n{kind}: layer_sizes={layer_sizes} "
+        f"({n_params:,} parameters)",
+        prefix=False, backend=devices[0].platform, devices=len(devices),
+        solver=kind, n_params=int(n_params))

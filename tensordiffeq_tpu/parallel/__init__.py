@@ -30,6 +30,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..telemetry import log_event
+
 DATA_AXIS = "data"
 
 
@@ -70,8 +72,9 @@ def shard_data_inputs(X_f, lambdas: dict, mesh: Optional[Mesh] = None):
     N = int(X_f.shape[0])
     N_keep = N - N % n_dev
     if N_keep != N:
-        print(f"[parallel] trimming collocation set {N} -> {N_keep} to tile "
-              f"{n_dev} devices")
+        log_event("parallel", f"trimming collocation set {N} -> {N_keep} "
+                  f"to tile {n_dev} devices", n_before=N, n_after=N_keep,
+                  devices=n_dev)
     X_sharded = jax.device_put(X_f[:N_keep], data_sharding(mesh, X_f.ndim))
 
     def place(lam, per_point_ok):

@@ -54,13 +54,24 @@ def timeit(fn: Callable, *args, iters: int = 10, warmup: int = 1,
     Runs ``warmup`` untimed calls (compilation), then ``iters`` timed calls
     with ``jax.block_until_ready`` on each result.  Returns
     ``{"mean_s", "min_s", "max_s", "iters", "result"}``.
+
+    Edge cases are explicit: ``iters`` must be ``>= 1`` (a timing run with
+    no timed calls has no result to return); ``warmup <= 0`` is legal and
+    skips the warmup sync entirely — the first *timed* call then pays any
+    compilation, which is sometimes exactly what should be measured
+    (cold-start latency).
     """
-    result = None
-    for _ in range(max(warmup, 0)):
-        result = fn(*args, **kwargs)
-    jax.block_until_ready(result)
+    if iters < 1:
+        raise ValueError(f"timeit needs iters >= 1, got {iters}")
+    if warmup > 0:
+        result = None
+        for _ in range(warmup):
+            result = fn(*args, **kwargs)
+        # sync only what the warmup actually computed; with warmup=0 there
+        # is nothing to sync (the old code fed a never-assigned result in)
+        jax.block_until_ready(result)
     times = []
-    for _ in range(max(iters, 1)):
+    for _ in range(iters):
         t0 = time.perf_counter()
         result = fn(*args, **kwargs)
         jax.block_until_ready(result)
@@ -83,8 +94,12 @@ def stopwatch(label: str = "", sync: Optional[Any] = None,
         if sync is not None:
             jax.block_until_ready(sync)
         out["elapsed_s"] = time.perf_counter() - t0
-        if verbose and label:
-            print(f"[profile] {label}: {out['elapsed_s']:.3f}s")
+        if label:
+            # lazy import: telemetry imports profiling.percentiles at module
+            # level, so the reverse edge must stay function-local
+            from .telemetry import log_event
+            log_event("profile", f"{label}: {out['elapsed_s']:.3f}s",
+                      verbose=verbose, elapsed_s=out["elapsed_s"])
 
 
 def percentiles(samples, qs=(50, 90, 99)) -> dict[str, Optional[float]]:
@@ -111,3 +126,16 @@ def device_memory_stats() -> dict[str, dict]:
         except Exception:
             stats[str(dev)] = {}
     return stats
+
+
+def device_memory_peak() -> Optional[int]:
+    """Max ``peak_bytes_in_use`` across devices, or ``None`` where the
+    backend reports no memory stats (CPU).  The one shared definition the
+    telemetry ``fit_end`` event and the bench payloads both quote."""
+    try:
+        peaks = [d.get("peak_bytes_in_use")
+                 for d in device_memory_stats().values()]
+        peaks = [p for p in peaks if p]
+        return max(peaks) if peaks else None
+    except Exception:
+        return None

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..profiling import percentiles, stopwatch
+from ..telemetry import default_registry
 
 
 class PendingQuery:
@@ -79,11 +80,19 @@ class RequestBatcher:
       max_latency_s: flush when the oldest pending request is this old.
       clock: time source (injectable for tests); defaults to
         ``time.monotonic``.
+      registry: :class:`~tensordiffeq_tpu.telemetry.MetricsRegistry`
+        receiving the batcher's health metrics — live queue depth
+        (``serving.batcher.queue_depth`` gauge), request/batch/point/
+        failure counters, the coalesced-batch-size histogram and the
+        per-request latency histogram (``serving.batcher.latency_s``).
+        Defaults to the process-wide shared registry; :meth:`stats` keeps
+        its original dict contract independently.
     """
 
     def __init__(self, engine=None, op: Optional[Callable] = None,
                  max_batch: int = 4096, max_latency_s: float = 0.01,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
         if op is None:
             if engine is None:
                 raise ValueError("pass an engine or an explicit op")
@@ -102,6 +111,7 @@ class RequestBatcher:
         self._n_points = 0
         self._n_failed = 0
         self._last_flush: Optional[float] = None
+        self._metrics = registry if registry is not None else default_registry()
 
     # ------------------------------------------------------------------ #
     @property
@@ -120,6 +130,8 @@ class RequestBatcher:
         self._pending.append((X, handle, now))
         self._pending_pts += X.shape[0]
         self._n_requests += 1
+        self._metrics.gauge("serving.batcher.queue_depth").set(
+            self._pending_pts)
         if self._pending_pts >= self.max_batch:
             self.flush()
         return handle
@@ -141,6 +153,7 @@ class RequestBatcher:
             return 0
         batch, self._pending = self._pending, []
         self._pending_pts = 0
+        self._metrics.gauge("serving.batcher.queue_depth").set(0)
         X = np.concatenate([x for x, _, _ in batch]) if len(batch) > 1 \
             else batch[0][0]
         try:
@@ -153,8 +166,10 @@ class RequestBatcher:
             for _, handle, _ in batch:
                 handle._fail(e)
             self._n_failed += len(batch)
+            self._metrics.counter("serving.batcher.failed").inc(len(batch))
             raise
         done = self._clock()
+        lat_hist = self._metrics.histogram("serving.batcher.latency_s")
         offset = 0
         for x, handle, t_submit in batch:
             n = x.shape[0]
@@ -164,9 +179,15 @@ class RequestBatcher:
                 handle._set(out[offset:offset + n])
             offset += n
             self._latencies.append(done - t_submit)
+            lat_hist.observe(done - t_submit)
         self._batch_walls.append(sw["elapsed_s"])
         self._n_batches += 1
         self._n_points += X.shape[0]
+        self._metrics.counter("serving.batcher.requests").inc(len(batch))
+        self._metrics.counter("serving.batcher.batches").inc()
+        self._metrics.counter("serving.batcher.points").inc(int(X.shape[0]))
+        self._metrics.histogram("serving.batcher.batch_size").observe(
+            X.shape[0])
         self._last_flush = done
         return len(batch)
 

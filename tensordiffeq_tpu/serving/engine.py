@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.derivatives import d, make_ufn, vmap_residual
+from ..telemetry import default_registry, log_event
 from .surrogate import Surrogate
 
 
@@ -60,11 +61,18 @@ class InferenceEngine:
         (all local devices, params replicated).  ``min_bucket`` must tile
         the device count (powers of two always do for power-of-two meshes).
       donate: donate the padded input buffer to the compiled program.
+      registry: :class:`~tensordiffeq_tpu.telemetry.MetricsRegistry`
+        receiving the engine's health metrics — per-(kind, bucket) compile
+        counts (``serving.engine.compiles``), points served
+        (``serving.engine.points``), and the pad-waste ratio histogram
+        (``serving.engine.pad_waste``: padded-but-unused fraction of each
+        bucket, the bucketing overhead an operator tunes ``min_bucket``
+        against).  Defaults to the process-wide shared registry.
     """
 
     def __init__(self, surrogate: Surrogate, min_bucket: int = 256,
                  max_bucket: int = 1 << 20, shard: bool = False,
-                 donate: bool = True):
+                 donate: bool = True, registry=None):
         if _next_pow2(min_bucket) != min_bucket \
                 or _next_pow2(max_bucket) != max_bucket:
             raise ValueError("min_bucket and max_bucket must be powers of "
@@ -89,6 +97,7 @@ class InferenceEngine:
             self._sharding = data_sharding(mesh, ndim=2)
         self._jitted: dict = {}      # kind -> jitted callable(params, X)
         self._cache_keys: set = set()  # (kind, bucket) shapes ever compiled
+        self._metrics = registry if registry is not None else default_registry()
 
     # ------------------------------------------------------------------ #
     @property
@@ -130,7 +139,22 @@ class InferenceEngine:
         Xd = (jnp.asarray(X) if self._sharding is None
               else jax.device_put(X, self._sharding))
         out = self._jit_for(kind, make_fn)(self.surrogate.params, Xd)
-        self._cache_keys.add((kind, bucket))
+        key = (kind, bucket)
+        if key not in self._cache_keys:
+            # first touch of this ladder rung: a real XLA compile happened
+            self._cache_keys.add(key)
+            klabel = kind if isinstance(kind, str) \
+                else ":".join(map(str, kind))
+            self._metrics.counter("serving.engine.compiles",
+                                  kind=klabel, bucket=bucket).inc()
+            log_event("serving",
+                      f"compiled kind={klabel} bucket={bucket} "
+                      f"({len(self._cache_keys)} programs cached)",
+                      verbose=False, kind_label=klabel, bucket=bucket,
+                      programs=len(self._cache_keys))
+        self._metrics.counter("serving.engine.points").inc(int(n))
+        self._metrics.histogram("serving.engine.pad_waste").observe(
+            (bucket - n) / bucket)
         return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
 
     def _query(self, kind, make_fn: Callable, X):

@@ -1,0 +1,48 @@
+"""First-class observability: metrics registry, JSONL run logs, training
+and serving health diagnostics.
+
+The reference narrates training through scattered ``print()`` calls and
+keeps no machine-readable record of what a run did.  This package is the
+shared observability layer for training, serving, and bench:
+
+* :class:`MetricsRegistry` — counters, gauges, streaming (reservoir)
+  histograms with labeled scopes, exported as plain dicts; percentile
+  semantics single-sourced through
+  :func:`tensordiffeq_tpu.profiling.percentiles`.
+* :class:`RunLogger` + :func:`log_event` — a schema-versioned JSONL event
+  sink with a run manifest, and the single leveled narration path the
+  package's former bare prints route through (quiet runs stay quiet;
+  events land in the sink either way).
+* :class:`TrainingTelemetry` / :class:`TrainingDiverged` — the callback
+  protocol ``solver.fit(telemetry=)`` threads through Adam and L-BFGS:
+  per-epoch loss components, gradient global-norm, SA-λ distribution
+  summaries, ``block_until_ready``-fenced step-time breakdown, checkpoint
+  events, and a NaN/Inf sentinel that raises a structured diagnosis
+  instead of silently poisoning the history.
+* :func:`report` / :func:`summarize` — render a run directory's JSONL
+  into a human diagnosis (divergence point, λ saturation, slowest phase,
+  memory peak).
+
+Typical use::
+
+    from tensordiffeq_tpu import telemetry
+
+    with telemetry.RunLogger("runs/ac_sa", config={"n_f": 50_000}) as run:
+        solver.fit(tf_iter=10_000, newton_iter=10_000, telemetry=run)
+    print(telemetry.report("runs/ac_sa"))
+
+The serving engine/batcher record their health metrics (per-bucket compile
+counts, pad-waste ratio, queue depth, coalesced-batch sizes, latency
+percentiles) into :func:`default_registry` unless given their own, and
+``bench.py`` snapshots the same registry into every JSON artifact's
+``telemetry`` block.
+"""
+
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, MetricsScope, default_registry)
+from .runlog import (EVENTS_FILE, MANIFEST_FILE,  # noqa: F401
+                     SCHEMA_VERSION, RunLogger, active_logger, log_event,
+                     read_events, read_manifest)
+from .hooks import (TrainingDiverged, TrainingTelemetry,  # noqa: F401
+                    as_training_telemetry, lambda_summaries)
+from .report import report, summarize  # noqa: F401

@@ -1,0 +1,225 @@
+"""Training instrumentation: the callback protocol ``fit_adam`` / L-BFGS /
+``CollocationSolverND.fit`` thread their telemetry through.
+
+:class:`TrainingTelemetry` is the subscriber object: pass one (or a bare
+:class:`~tensordiffeq_tpu.telemetry.RunLogger`) to ``solver.fit(telemetry=)``
+and the run emits structured events — run config, per-epoch loss
+components + gradient global-norm, SA-λ distribution summaries, step-time
+breakdown (dispatch vs device wait, ``block_until_ready``-fenced),
+checkpoint writes — instead of narration that scripts would have to scrape
+off stdout.  The NaN/Inf sentinel turns a silently-poisoned loss history
+into a structured :class:`TrainingDiverged` with the tripping components
+attached (and a ``divergence`` event on the sink either way).
+
+Everything here is host-side and chunk-cadence: the jitted training scan
+is untouched except for the optional gradient-norm scalar it returns when
+a subscriber is attached.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..profiling import percentiles
+from .registry import MetricsRegistry, default_registry
+from .runlog import RunLogger
+
+
+class TrainingDiverged(RuntimeError):
+    """The NaN/Inf sentinel tripped: a loss component went non-finite.
+
+    Carries ``phase`` ("adam" / "l-bfgs"), ``epoch`` (run-relative), and
+    ``components`` (the loss dict at the trip) so callers can diagnose
+    programmatically instead of parsing a message.
+    """
+
+    def __init__(self, phase: str, epoch: int, components: Optional[dict] = None):
+        self.phase = phase
+        self.epoch = int(epoch)
+        self.components = dict(components or {})
+        bad = sorted(k for k, v in self.components.items()
+                     if isinstance(v, float) and not np.isfinite(v))
+        super().__init__(
+            f"training diverged: non-finite loss at {phase} epoch "
+            f"{epoch} (non-finite: {', '.join(bad) or 'unknown'})")
+
+
+def lambda_summaries(lambdas: dict) -> dict:
+    """min/mean/max/p99 per λ term (``{"residual[0]": {...}, ...}``);
+    scalar λ report a single ``value``.  Host transfer per term — chunk
+    cadence only.  Terms that cannot be read on this host (multi-host
+    sharded arrays) are skipped, not fatal."""
+    out = {}
+    for group, terms in (lambdas or {}).items():
+        for i, lam in enumerate(terms):
+            if lam is None:
+                continue
+            try:
+                arr = np.asarray(lam, dtype=np.float64)
+            except Exception:
+                continue
+            if arr.size == 0:
+                continue
+            name = f"{group}[{i}]"
+            if arr.size == 1:
+                out[name] = {"value": float(arr.reshape(-1)[0])}
+            else:
+                out[name] = {"min": float(arr.min()),
+                             "mean": float(arr.mean()),
+                             "max": float(arr.max()),
+                             # single-sourced percentile semantics
+                             # (profiling.py), same as every other p99
+                             "p99": percentiles(arr.ravel(),
+                                                qs=(99,))["p99"]}
+    return out
+
+
+def _nonfinite(row: dict) -> bool:
+    return any(isinstance(v, float) and not np.isfinite(v)
+               for v in row.values())
+
+
+class TrainingTelemetry:
+    """Subscriber threaded through the training loops.
+
+    Args:
+      logger: a :class:`RunLogger` receiving the structured events, or
+        None for metrics-only instrumentation (step-time/divergence
+        counters land in ``registry``, no JSONL).
+      registry: metrics destination; defaults to the logger's registry,
+        else the process default.
+      log_every: per-epoch ``epoch`` event cadence (1 = every epoch,
+        0 = none; chunk-boundary events are unaffected).
+      raise_on_divergence: raise :class:`TrainingDiverged` when the Adam
+        sentinel trips (the L-BFGS loop already stops itself on NaN and
+        keeps its best iterate, so its trips only emit the event).
+      grad_norm: compute the gradient global-norm inside the compiled
+        step.  ``False`` keeps the compiled program bit-identical to an
+        un-instrumented run — required when the run IS the measurement
+        (``bench.py --full``), where even one extra reduction per step
+        would skew the headline against earlier captures.
+    """
+
+    def __init__(self, logger: Optional[RunLogger] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 log_every: int = 1, raise_on_divergence: bool = True,
+                 grad_norm: bool = True):
+        self.logger = logger
+        self.registry = registry if registry is not None else (
+            logger.registry if logger is not None else default_registry())
+        self.log_every = int(log_every)
+        self.raise_on_divergence = bool(raise_on_divergence)
+        self.grad_norm = bool(grad_norm)
+        # run-relative rebasing across causal-ε stages / resumed legs:
+        # the solver sets this so event epochs stay monotonic
+        self.epoch_offset = 0
+
+    # ------------------------------------------------------------------ #
+    def event(self, kind: str, **fields):
+        if self.logger is not None:
+            self.logger.event(kind, **fields)
+
+    def on_fit_start(self, config: dict):
+        self.event("run_config", **config)
+
+    def on_epoch_rows(self, phase: str, first_epoch: int, rows: list):
+        """One chunk's per-epoch loss rows (``first_epoch`` = run-relative
+        epoch of ``rows[0]``); emits ``epoch`` events strictly on the
+        ``log_every`` cadence (epoch % log_every == 0) with the gradient
+        global-norm split out of the loss components."""
+        if self.log_every <= 0:
+            return
+        for i, row in enumerate(rows):
+            epoch = first_epoch + i + self.epoch_offset
+            if epoch % self.log_every:
+                continue
+            losses = {k: v for k, v in row.items() if k != "Grad_norm"}
+            self.event("epoch", phase=phase, epoch=epoch, losses=losses,
+                       grad_norm=row.get("Grad_norm"))
+
+    def on_step_time(self, phase: str, n_steps: int, dispatch_s: float,
+                     device_s: float, data_s: float = 0.0):
+        """Chunk step-time split: host dispatch (time until the async jit
+        call returned) vs device wait (``block_until_ready`` fence) vs
+        data prep (batch rebuilds)."""
+        n = max(int(n_steps), 1)
+        scope = self.registry.scope(phase=phase)
+        scope.histogram("step_time_dispatch_s").observe(dispatch_s / n)
+        scope.histogram("step_time_device_s").observe(device_s / n)
+        if data_s:
+            scope.histogram("step_time_data_s").observe(data_s / n)
+        self.event("step_time", phase=phase, n_steps=n_steps,
+                   dispatch_s=dispatch_s, device_s=device_s, data_s=data_s)
+
+    def on_lambda_stats(self, epoch: int, lambdas: dict):
+        stats = lambda_summaries(lambdas)
+        if stats:
+            self.event("lambda_stats", epoch=epoch + self.epoch_offset,
+                       stats=stats)
+
+    def on_checkpoint(self, phase: str, epoch: int):
+        """``epoch`` is absolute (the solver rebases before calling — its
+        checkpoint hooks already carry run-relative epochs)."""
+        self.registry.counter("checkpoints").inc()
+        self.event("checkpoint", phase=phase, epoch=epoch)
+
+    def check_finite(self, phase: str, epoch: int, row: dict):
+        """The NaN/Inf sentinel.  Emits a ``divergence`` event (and bumps
+        the ``divergences`` counter) on a non-finite loss component;
+        raises :class:`TrainingDiverged` per the constructor policy."""
+        if not _nonfinite(row):
+            return
+        epoch = int(epoch) + self.epoch_offset
+        components = {k: v for k, v in row.items()}
+        self.registry.counter("divergences", phase=phase).inc()
+        self.event("divergence", phase=phase, epoch=epoch,
+                   components=components, level="error")
+        if self.raise_on_divergence and phase == "adam":
+            raise TrainingDiverged(phase, epoch, components)
+
+    def check_rows(self, phase: str, first_epoch: int, rows: list):
+        """Run the sentinel over a chunk's per-epoch rows, tripping at the
+        FIRST non-finite epoch (the divergence point, not the chunk end)."""
+        for j, row in enumerate(rows):
+            if _nonfinite(row):
+                self.check_finite(phase, first_epoch + j, row)
+                return
+
+    def on_lbfgs_history(self, history: list, start_iter: int = 0):
+        """Post-phase L-BFGS telemetry: sampled per-iteration ``epoch``
+        events plus the divergence event for a NaN stop (the loop already
+        stopped and kept its best iterate — event only, no raise)."""
+        rows = [{"Total Loss": float(v)} for v in history]
+        if rows:
+            self.on_epoch_rows("l-bfgs", start_iter, rows)
+            if _nonfinite(rows[-1]):
+                self.registry.counter("divergences", phase="l-bfgs").inc()
+                self.event("divergence", phase="l-bfgs",
+                           epoch=start_iter + len(rows) - 1
+                           + self.epoch_offset,
+                           components=rows[-1], level="error")
+
+    def on_fit_end(self, summary: dict):
+        """Close out the fit: wall times, best losses, and the per-device
+        memory peak (``profiling.device_memory_stats``) where the backend
+        reports one."""
+        from ..profiling import device_memory_peak
+        peak = device_memory_peak()
+        if peak is not None:
+            self.registry.gauge("device_memory_peak_bytes").set(peak)
+        self.event("fit_end", memory_peak_bytes=peak, **summary)
+
+
+def as_training_telemetry(telemetry) -> Optional[TrainingTelemetry]:
+    """Normalise ``solver.fit(telemetry=)`` input: a
+    :class:`TrainingTelemetry` passes through, a :class:`RunLogger` is
+    wrapped with defaults, None stays None."""
+    if telemetry is None or isinstance(telemetry, TrainingTelemetry):
+        return telemetry
+    if isinstance(telemetry, RunLogger):
+        return TrainingTelemetry(logger=telemetry)
+    raise TypeError(
+        f"telemetry must be a TrainingTelemetry or RunLogger, got "
+        f"{type(telemetry).__name__}")

@@ -1,0 +1,228 @@
+"""``telemetry.report(run_dir)`` — render a run's JSONL into a diagnosis.
+
+The inverse of the event sink: reads ``manifest.json`` + ``events.jsonl``
+and answers the operator questions directly — did it diverge (and where),
+what was the loss trajectory, are the SA-λ saturating, which phase of the
+step ate the wall clock, how much device memory did it peak at — instead
+of leaving the caller to grep JSON.  Pure read path: safe on a live run
+directory (events are appended line-atomically) and on a killed run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .runlog import NONFINITE_TOKENS, read_events, read_manifest
+
+# λ saturation heuristic: a per-point λ distribution whose p99 runs this
+# many times past its mean is dominated by a thin set of runaway points —
+# the practical precursor of SA minimax blow-up (cf. bounded-g discussion
+# in DiscoveryModel docs)
+LAMBDA_SATURATION_RATIO = 50.0
+
+
+def _fmt(v, digits: int = 4) -> str:
+    if v is None:
+        return "?"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def _phase_epochs(events: list) -> dict:
+    out: dict = {}
+    for e in events:
+        if e.get("kind") == "epoch":
+            ph = e.get("phase", "?")
+            out.setdefault(ph, []).append(e)
+    return out
+
+
+def summarize(run_dir: str) -> dict:
+    """Machine-readable digest of a run directory (what :func:`report`
+    renders).  Keys are stable; absent data maps to None/empty."""
+    try:
+        manifest = read_manifest(run_dir)
+    except OSError:
+        manifest = {}
+    events = read_events(run_dir)
+
+    def of_kind(kind):
+        # filter the already-parsed list — one disk read serves every
+        # section, which matters at per-epoch event volumes
+        return [e for e in events if e.get("kind") == kind]
+
+    by_phase = _phase_epochs(events)
+    losses = {}
+    for ph, rows in by_phase.items():
+        first, last = rows[0], rows[-1]
+        totals = [r.get("losses", {}).get("Total Loss") for r in rows]
+        totals = [t for t in totals if isinstance(t, (int, float))]
+        losses[ph] = {
+            "epochs_logged": len(rows),
+            "first_epoch": first.get("epoch"),
+            "last_epoch": last.get("epoch"),
+            "first_total": totals[0] if totals else None,
+            "last_total": totals[-1] if totals else None,
+            "best_total": min(totals) if totals else None,
+            "first_grad_norm": first.get("grad_norm"),
+            "last_grad_norm": last.get("grad_norm"),
+            "last_components": last.get("losses", {}),
+        }
+
+    divergences = of_kind("divergence")
+    lam_events = of_kind("lambda_stats")
+    lam_last = lam_events[-1] if lam_events else None
+    saturated = []
+    if lam_last:
+        for name, s in (lam_last.get("stats") or {}).items():
+            mean, p99 = s.get("mean"), s.get("p99")
+            # a diverged run's λ stats come back as non-finite string
+            # tokens ("Infinity") — only numeric values can saturate
+            if not isinstance(mean, (int, float)) \
+                    or not isinstance(p99, (int, float)):
+                continue
+            if mean and p99 and p99 / max(abs(mean), 1e-30) \
+                    >= LAMBDA_SATURATION_RATIO:
+                saturated.append((name, p99 / abs(mean)))
+
+    step_time: dict = {}
+    for e in of_kind("step_time"):
+        ph = e.get("phase", "?")
+        agg = step_time.setdefault(
+            ph, {"dispatch_s": 0.0, "device_s": 0.0, "data_s": 0.0,
+                 "n_steps": 0})
+        for k in ("dispatch_s", "device_s", "data_s"):
+            agg[k] += float(e.get(k) or 0.0)
+        agg["n_steps"] += int(e.get("n_steps") or 0)
+
+    fit_end = of_kind("fit_end")
+    mem_peak = None
+    for e in fit_end:
+        if e.get("memory_peak_bytes"):
+            mem_peak = max(mem_peak or 0, e["memory_peak_bytes"])
+
+    return {
+        "manifest": manifest,
+        "n_events": len(events),
+        "config": (of_kind("run_config") or [{}])[-1],
+        "losses": losses,
+        "divergences": divergences,
+        "lambda_last": lam_last,
+        "lambda_saturated": saturated,
+        "step_time": step_time,
+        "checkpoints": len(of_kind("checkpoint")),
+        "fit_end": fit_end[-1] if fit_end else None,
+        "memory_peak_bytes": mem_peak,
+    }
+
+
+def report(run_dir: str, width: int = 72) -> str:
+    """Human diagnosis of a run directory — divergence point, loss
+    trajectory per phase, λ saturation, slowest step phase, memory peak.
+    Returns the rendered text (print it yourself; nothing here writes to
+    stdout)."""
+    s = summarize(run_dir)
+    man = s["manifest"]
+    lines = []
+    bar = "=" * width
+
+    lines.append(bar)
+    env = man.get("environment", {})
+    lines.append(f"telemetry report — {man.get('run_id', run_dir)}")
+    lines.append(
+        f"schema v{man.get('schema_version', '?')} | "
+        f"{s['n_events']} events | backend "
+        f"{env.get('backend', '?')} x{env.get('device_count', '?')} "
+        f"({env.get('device_kind', '?')})")
+    if man.get("created") is not None and man.get("ended") is not None:
+        lines.append(f"wall span: {man['ended'] - man['created']:.1f}s "
+                     "(manifest created -> closed)")
+    lines.append(bar)
+
+    cfg = {k: v for k, v in s["config"].items()
+           if k not in ("v", "t", "kind")}
+    if cfg:
+        lines.append("config: " + ", ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(cfg.items())))
+
+    # -- training trajectory ------------------------------------------- #
+    for ph, d in s["losses"].items():
+        lines.append(
+            f"[{ph}] epochs {_fmt(d['first_epoch'])}..{_fmt(d['last_epoch'])}"
+            f" ({d['epochs_logged']} logged): total loss "
+            f"{_fmt(d['first_total'])} -> {_fmt(d['last_total'])}"
+            f" (best {_fmt(d['best_total'])})")
+        if d["last_grad_norm"] is not None:
+            lines.append(f"[{ph}] grad global-norm "
+                         f"{_fmt(d['first_grad_norm'])} -> "
+                         f"{_fmt(d['last_grad_norm'])}")
+        comps = {k: v for k, v in d["last_components"].items()
+                 if k != "Total Loss"}
+        if comps:
+            lines.append(f"[{ph}] final components: " + ", ".join(
+                f"{k}={_fmt(v)}" for k, v in comps.items()))
+
+    # -- divergence ----------------------------------------------------- #
+    if s["divergences"]:
+        d0 = s["divergences"][0]
+        comps0 = d0.get("components") or {}
+        bad = ", ".join(
+            f"{k}={_fmt(v)}" for k, v in comps0.items()
+            if (isinstance(v, float) and not np.isfinite(v))
+            or v in NONFINITE_TOKENS) or "non-finite components"
+        lines.append(f"DIVERGED at {d0.get('phase')} epoch "
+                     f"{d0.get('epoch')}: {bad}")
+        lines.append("  -> history after this point is untrustworthy; "
+                     "lower lr / check init_weights / enable remat "
+                     "before rerunning")
+    else:
+        lines.append("no divergence detected (NaN/Inf sentinel never "
+                     "tripped)")
+
+    # -- λ health ------------------------------------------------------- #
+    if s["lambda_last"] is not None:
+        stats = s["lambda_last"].get("stats") or {}
+        desc = []
+        for name, st in stats.items():
+            if "value" in st:
+                desc.append(f"{name}={_fmt(st['value'])}")
+            else:
+                desc.append(f"{name}: mean {_fmt(st.get('mean'))}, "
+                            f"max {_fmt(st.get('max'))}, "
+                            f"p99 {_fmt(st.get('p99'))}")
+        lines.append("SA-λ (last): " + "; ".join(desc))
+        for name, ratio in s["lambda_saturated"]:
+            lines.append(f"  λ SATURATION: {name} p99/mean = {ratio:.0f}x "
+                         f"(>= {LAMBDA_SATURATION_RATIO:.0f}x) — a thin "
+                         "set of points dominates the minimax; consider "
+                         "a bounded g= transform or lower lr_weights")
+
+    # -- step-time breakdown ------------------------------------------- #
+    for ph, agg in s["step_time"].items():
+        total = agg["dispatch_s"] + agg["device_s"] + agg["data_s"]
+        if total <= 0 or not agg["n_steps"]:
+            continue
+        slowest = max(("dispatch", "device", "data"),
+                      key=lambda k: agg[f"{k}_s"])
+        lines.append(
+            f"[{ph}] step-time: {agg['n_steps']} steps, "
+            f"dispatch {agg['dispatch_s']:.2f}s / device "
+            f"{agg['device_s']:.2f}s / data {agg['data_s']:.2f}s "
+            f"-> slowest phase: {slowest} "
+            f"({agg[f'{slowest}_s'] / total:.0%} of measured wall)")
+
+    if s["checkpoints"]:
+        lines.append(f"checkpoints written: {s['checkpoints']}")
+    if s["memory_peak_bytes"]:
+        lines.append(
+            f"device memory peak: {s['memory_peak_bytes'] / 2**20:.1f} MiB")
+    fe = s["fit_end"]
+    if fe:
+        extras = {k: v for k, v in fe.items()
+                  if k not in ("v", "t", "kind", "memory_peak_bytes")}
+        if extras:
+            lines.append("fit summary: " + ", ".join(
+                f"{k}={_fmt(v)}" for k, v in sorted(extras.items())))
+    lines.append(bar)
+    return "\n".join(lines)

@@ -1,0 +1,228 @@
+"""JSONL event sink + run manifest + the one leveled narration path.
+
+A run directory holds two files:
+
+* ``manifest.json`` — schema version, run id, creation time, the run
+  config the caller registered, and the environment (backend, devices);
+  finalized on :meth:`RunLogger.close` with the end time and a metrics
+  snapshot from the attached registry.
+* ``events.jsonl`` — append-only, one schema-versioned JSON record per
+  line: ``{"v": 1, "t": <unix s>, "kind": "...", ...}``.  Appends are
+  flushed per event, so a killed run keeps everything up to the kill.
+
+:func:`log_event` is the single narration path the package routes its
+former bare ``print()`` lines through: a message prints only when the
+caller's ``verbose`` flag says so (quiet runs are actually quiet), but the
+event is *always* appended to the active :class:`RunLogger` when one is
+attached — machine-readable even when silent.  Warnings and errors print
+to stderr so bench workers' JSON-line stdout protocol stays clean.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from .registry import MetricsRegistry, default_registry
+
+SCHEMA_VERSION = 1
+EVENTS_FILE = "events.jsonl"
+MANIFEST_FILE = "manifest.json"
+
+# stack, not a single slot: nested runs (a solver fit inside a bench
+# harness that keeps its own log) resolve to the innermost logger
+_ACTIVE: list = []
+
+
+def active_logger() -> Optional["RunLogger"]:
+    """The innermost attached :class:`RunLogger`, or None."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def _json_default(obj: Any):
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return str(obj)
+
+
+# spec-valid JSON has no NaN/Infinity tokens; divergence records are exactly
+# where they appear, and a strict consumer (jq, a dashboard ingester) must
+# be able to parse exactly those lines — encode them as strings instead
+NONFINITE_TOKENS = {"NaN", "Infinity", "-Infinity"}
+
+
+def _sanitize(v):
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and not math.isfinite(v):
+        return "NaN" if math.isnan(v) else (
+            "Infinity" if v > 0 else "-Infinity")
+    if isinstance(v, np.ndarray):
+        return _sanitize(v.tolist())
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+def log_event(kind: str, message: Optional[str] = None, *,
+              level: str = "info", verbose: bool = True,
+              prefix: bool = True, logger: Optional["RunLogger"] = None,
+              **fields):
+    """Narrate + record in one call.
+
+    ``message`` prints as ``[kind] message`` iff ``verbose`` (callers pass
+    their existing ``verbose`` flags through); ``level`` in
+    ``("warning", "error")`` prints to stderr, everything else to stdout.
+    ``prefix=False`` prints the message bare (banners).  Independently of
+    printing, the event — kind, level, message, and any extra ``fields``
+    — is appended to ``logger`` (default: the active run logger) when one
+    exists, so a quiet run still leaves a machine-readable trail.
+    """
+    if verbose and message is not None:
+        stream = sys.stderr if level in ("warning", "error") else sys.stdout
+        print(f"[{kind}] {message}" if prefix else message,
+              file=stream, flush=True)
+    lg = logger if logger is not None else active_logger()
+    if lg is not None:
+        rec = dict(fields)
+        if message is not None:
+            rec["message"] = message
+        if level != "info":
+            rec["level"] = level
+        lg.event(kind, **rec)
+
+
+class RunLogger:
+    """Schema-versioned JSONL event sink for one run.
+
+    Usage::
+
+        with telemetry.RunLogger("runs/ac_sa_0", config={...}) as run:
+            solver.fit(tf_iter=10_000, telemetry=run)
+        print(telemetry.report("runs/ac_sa_0"))
+
+    As a context manager the logger also becomes the *active* sink for
+    :func:`log_event`, so package narration ([fit]/[autotune]/[causal]
+    lines) lands in ``events.jsonl`` alongside the structured training
+    events.  ``registry`` defaults to the process-wide
+    :func:`~tensordiffeq_tpu.telemetry.default_registry` so serving/bench
+    metrics snapshot into the manifest on close.
+    """
+
+    def __init__(self, run_dir: str, config: Optional[dict] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 run_id: Optional[str] = None, clock=time.time):
+        self.run_dir = str(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.registry = registry if registry is not None else default_registry()
+        self._clock = clock
+        self.run_id = run_id or f"run-{os.getpid()}-{int(clock() * 1e3):x}"
+        self.n_events = 0
+        self._closed = False
+        self._manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "created": self._clock(),
+            "config": dict(config or {}),
+            "environment": self._environment(),
+        }
+        self._write_manifest()
+        self._fh = open(os.path.join(self.run_dir, EVENTS_FILE), "a")
+
+    @staticmethod
+    def _environment() -> dict:
+        try:
+            import jax
+            devs = jax.devices()
+            return {"backend": jax.default_backend(),
+                    "device_count": len(devs),
+                    "device_kind": devs[0].device_kind,
+                    "jax_version": jax.__version__}
+        except Exception as e:  # never let env introspection kill a run
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _write_manifest(self):
+        path = os.path.join(self.run_dir, MANIFEST_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(_sanitize(self._manifest), fh, indent=1,
+                      allow_nan=False, default=_json_default)
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------------ #
+    def event(self, kind: str, **fields):
+        """Append one schema-versioned record; flushed immediately so a
+        killed process loses nothing already logged."""
+        if self._closed:
+            raise ValueError(f"RunLogger for {self.run_dir} is closed")
+        rec = {"v": SCHEMA_VERSION, "t": round(self._clock(), 6),
+               "kind": str(kind)}
+        rec.update(fields)
+        self._fh.write(json.dumps(_sanitize(rec), allow_nan=False,
+                                  default=_json_default) + "\n")
+        self._fh.flush()
+        self.n_events += 1
+
+    def close(self):
+        """Finalize: flush the sink and rewrite the manifest with the end
+        time, event count, and a metrics snapshot."""
+        if self._closed:
+            return
+        self._closed = True
+        self._fh.close()
+        self._manifest["ended"] = self._clock()
+        self._manifest["n_events"] = self.n_events
+        try:
+            self._manifest["metrics"] = self.registry.as_dict()
+        except Exception:
+            pass
+        self._write_manifest()
+        with contextlib.suppress(ValueError):
+            _ACTIVE.remove(self)
+
+    # ------------------------------------------------------------------ #
+    def __enter__(self) -> "RunLogger":
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def read_manifest(run_dir: str) -> dict:
+    with open(os.path.join(run_dir, MANIFEST_FILE)) as fh:
+        return json.load(fh)
+
+
+def read_events(run_dir: str, kind: Optional[str] = None) -> list:
+    """Parse ``events.jsonl`` back into dicts (optionally one ``kind``).
+    A truncated final line (process killed mid-write) is skipped, not
+    fatal — same salvage stance as ``bench.last_json_line``."""
+    out = []
+    path = os.path.join(run_dir, EVENTS_FILE)
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..telemetry import log_event
 from ..utils import tree_copy
 from .progress import progress_bar
 
@@ -122,9 +123,9 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
             if bsz % n_dev:
                 orig = bsz
                 bsz = max(bsz - bsz % n_dev, n_dev)
-                if verbose:
-                    print(f"[fit] batch_sz {orig} -> {bsz} so each of "
-                          f"the {n_dev} devices gets equal batch rows")
+                log_event("fit", f"batch_sz {orig} -> {bsz} so each of "
+                          f"the {n_dev} devices gets equal batch rows",
+                          verbose=verbose)
         n_batches = -(-N_f // bsz)  # ceil: keep every row
 
     if mesh is not None and n_batches > 1:
@@ -133,11 +134,12 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
         from ..parallel import DATA_AXIS
         n_dev = int(np.prod(mesh.devices.shape))
         shard_rows = N_f // n_dev
-        if verbose and shard_rows * n_dev != N_f:
+        if shard_rows * n_dev != N_f:
             # normal dist flows never hit this (shard_data_inputs trims to
             # a device multiple first); a direct caller should know
-            print(f"[fit] {N_f % n_dev} rows beyond the {n_dev}-device "
-                  f"multiple fall outside every shard block and never train")
+            log_event("fit", f"{N_f % n_dev} rows beyond the {n_dev}-device "
+                      "multiple fall outside every shard block and never "
+                      "train", verbose=verbose, level="warning")
         bsz_local = bsz // n_dev
         n_batches = -(-shard_rows // bsz_local)  # ceil: keep every row
         if permute:
@@ -149,9 +151,10 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
         # wraparound within each device's block: the tail batch reuses
         # rows from the front of the SAME shard, keeping the gather local
         take = np.arange(n_batches * bsz_local) % shard_rows
-        if verbose and take.size != shard_rows:
-            print(f"[fit] tail batch wraps {take.size - shard_rows} rows "
-                  f"per shard so {bsz}-point batches cover every point")
+        if take.size != shard_rows:
+            log_event("fit", f"tail batch wraps {take.size - shard_rows} "
+                      f"rows per shard so {bsz}-point batches cover every "
+                      "point", verbose=verbose)
         idx = base[:, take] + (np.arange(n_dev) * shard_rows)[:, None]
         idx = idx.reshape(n_dev, n_batches, bsz_local)
         idx = np.swapaxes(idx, 0, 1).reshape(n_batches, bsz)  # [n_b, bsz]
@@ -167,9 +170,10 @@ def make_batches(X_f, batch_sz: Optional[int], mesh=None, verbose: bool = True,
             jnp.asarray(idx), NamedSharding(mesh, P(None, DATA_AXIS)))
     elif n_batches > 1:
         take = np.arange(n_batches * bsz) % N_f
-        if verbose and take.size != N_f:
-            print(f"[fit] tail batch wraps {take.size - N_f} rows so "
-                  f"{bsz}-point batches cover every point")
+        if take.size != N_f:
+            log_event("fit", f"tail batch wraps {take.size - N_f} rows so "
+                      f"{bsz}-point batches cover every point",
+                      verbose=verbose)
         if permute:
             idx = np.random.RandomState(0).permutation(N_f)[take]
         else:
@@ -201,7 +205,8 @@ class FitResult:
 
 
 def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
-                  n_batches: int, n_points: int):
+                  n_batches: int, n_points: int,
+                  with_grad_norm: bool = False):
     """Build the jitted multi-step runner.
 
     Returns ``run(trainables, opt_state, best, X_batched, idx_batched,
@@ -211,6 +216,11 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
     ``best`` carries ``(params_snapshot, best_loss, best_step)`` and is
     updated with a pytree select each step — a true copy, fixing the
     reference's aliasing best-model bug (SURVEY §2.4.6).
+
+    ``with_grad_norm=True`` (set when a telemetry subscriber is attached)
+    adds the optimizer-step gradient global-norm to the per-step components
+    as ``"Grad_norm"`` — one extra scalar reduction inside the compiled
+    step, the only piece of instrumentation that lives on-device.
     """
 
     def _is_per_point(lam):
@@ -242,6 +252,8 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
             X_b = X_batched[b] if n_batches > 1 else X_batched[0]
             idx_b = idx_batched[b] if n_batches > 1 else idx_batched[0]
             (total, comps), grads = grad_fn(trainables, X_b, idx_b)
+            if with_grad_norm:
+                comps = {**comps, "Grad_norm": optax.global_norm(grads)}
             updates, opt_state = opt.update(grads, opt_state, trainables)
             trainables = optax.apply_updates(trainables, updates)
 
@@ -285,6 +297,7 @@ def fit_adam(loss_fn: Callable,
              state_hook: Optional[Callable] = None,
              state_hook_every: int = 0,
              stop_fn: Optional[Callable] = None,
+             telemetry: Optional[Any] = None,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -319,7 +332,16 @@ def fit_adam(loss_fn: Callable,
     ``stop_fn(result) -> bool``: checked at chunk boundaries; returning
     True ends the phase early with the state as of that boundary (the
     staged causal-ε ladder uses this to hand the remaining budget to the
-    next ε stage the moment the causal gate opens)."""
+    next ε stage the moment the causal gate opens).
+
+    ``telemetry``: a :class:`~tensordiffeq_tpu.telemetry.TrainingTelemetry`
+    subscriber.  When attached, the compiled step also returns the gradient
+    global-norm (``"Grad_norm"`` in the loss history — a different jit key,
+    so toggling it recompiles once), and each chunk boundary reports
+    per-epoch loss rows, the SA-λ distribution summaries, the
+    dispatch/device/data step-time split (``block_until_ready``-fenced),
+    and runs the NaN/Inf sentinel — which may raise
+    :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
@@ -342,18 +364,30 @@ def fit_adam(loss_fn: Callable,
         opt_state = tree_copy(opt_state)
     # classify per-point λ by the full point count: λ keeps all N_f rows and
     # batch rows gather from them (the wraparound tail re-gathers front rows)
-    run = _chunk_runner(loss_fn, opt, n_batches, N_f)
+    run = _chunk_runner(
+        loss_fn, opt, n_batches, N_f,
+        with_grad_norm=(telemetry is not None
+                        and getattr(telemetry, "grad_norm", True)))
 
     best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
     total_steps = tf_iter * n_batches
     t0 = time.time()
     steps_done = 0
+    data_s = 0.0  # batch-rebuild (resample) time attributed to step-time
     pbar = progress_bar(tf_iter, desc="Adam") if verbose else None
     while steps_done < total_steps:
         n = int(min(chunk * n_batches, total_steps - steps_done))
+        t_chunk0 = time.perf_counter()
         trainables, opt_state, best, comps = run(
             trainables, opt_state, best, X_batched, idx_batched,
             jnp.asarray(steps_done), n)
+        if telemetry is not None:
+            # fence host dispatch vs device execution: run() returns as
+            # soon as the scan is dispatched; the block measures what the
+            # device is still busy with
+            t_disp = time.perf_counter() - t_chunk0
+            jax.block_until_ready(comps)
+            t_dev = time.perf_counter() - t_chunk0 - t_disp
         comps = jax.tree_util.tree_map(np.asarray, comps)
         # record one entry per epoch (last batch of each epoch)
         for e in range(n // n_batches):
@@ -362,9 +396,23 @@ def fit_adam(loss_fn: Callable,
         prev_epochs = steps_done // n_batches
         steps_done += n
         cur_epochs = steps_done // n_batches
+        if telemetry is not None:
+            n_ep = cur_epochs - prev_epochs
+            rows = result.losses[-n_ep:] if n_ep else []
+            telemetry.on_step_time("adam", n, t_disp, t_dev, data_s)
+            data_s = 0.0
+            telemetry.on_epoch_rows("adam", prev_epochs, rows)
+            telemetry.on_lambda_stats(cur_epochs, trainables["lambdas"])
+            try:
+                telemetry.check_rows("adam", prev_epochs, rows)
+            except Exception:
+                if pbar is not None:
+                    pbar.close()
+                raise
         if (resample_fn is not None and resample_every > 0
                 and steps_done < total_steps
                 and prev_epochs // resample_every != cur_epochs // resample_every):
+            t_data0 = time.perf_counter()
             X_new = resample_fn(trainables["params"], cur_epochs)
             if X_new.shape != X_f.shape:
                 raise ValueError(
@@ -374,6 +422,7 @@ def fit_adam(loss_fn: Callable,
             X_f = X_new
             X_batched, idx_batched, _ = make_batches(
                 X_f, batch_sz, mesh=mesh, verbose=False)
+            data_s += time.perf_counter() - t_data0
             # losses before/after a redraw are measured on different point
             # sets (importance sampling deliberately picks harder points) —
             # reset the threshold so best-model tracking keeps competing on

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..telemetry import log_event
 from ..utils import tree_copy
 from .progress import progress_bar
 
@@ -42,15 +43,15 @@ _tree_norm = (_optax_tree.norm if _optax_tree is not None
               else optax.tree_utils.tree_l2_norm)
 
 
-def _log_stop(msg: str) -> None:
-    """Early-stop diagnostics go to stderr unconditionally: a silent stop
+def _log_stop(msg: str, **fields) -> None:
+    """Early-stop diagnostics print to stderr unconditionally: a silent stop
     inside a long benchmark run is indistinguishable from a completed phase
     in the artifact (the 2026-08-01 north-star TPU capture lost its L-BFGS
     phase to an unexplained sub-1000-iter stop precisely because this was
     gated on ``verbose``).  stderr, not stdout — bench workers speak
-    JSON-line protocol on stdout."""
-    import sys
-    print(f"[l-bfgs] {msg}", file=sys.stderr, flush=True)
+    JSON-line protocol on stdout; ``log_event`` routes warnings there and
+    mirrors the stop into any active telemetry run log."""
+    log_event("l-bfgs", msg, level="warning", **fields)
 
 
 def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
@@ -59,7 +60,8 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    verbose: bool = False, eager: bool = False,
                    learning_rate: float = 0.8,
                    callback: Optional[Callable] = None,
-                   callback_every: int = 0, args: tuple = ()):
+                   callback_every: int = 0, args: tuple = (),
+                   telemetry=None):
     """Minimise ``fun(pytree, *args) -> scalar`` with jitted L-BFGS.
 
     Returns ``(x_final, x_best, f_best, best_iter, history)`` where
@@ -74,6 +76,11 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     global sharded array is illegal under multi-host
     (``jax.distributed``-initialized) execution, where each process only
     addresses its own shard.
+
+    ``telemetry``: optional
+    :class:`~tensordiffeq_tpu.telemetry.TrainingTelemetry` — records the
+    per-chunk dispatch/device step-time split (``block_until_ready``
+    fenced), same contract as the Adam loop's.
     """
     if eager:
         opt = optax.lbfgs(learning_rate=learning_rate,
@@ -143,8 +150,15 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     pbar = progress_bar(maxiter, desc="L-BFGS") if verbose else None
     while done < maxiter:
         n = int(min(chunk, maxiter - done))
+        t_chunk0 = time.perf_counter()
         x, state, best, values, gnorms = run_chunk(
             x, state, best, jnp.asarray(done), args, n)
+        if telemetry is not None:
+            t_disp = time.perf_counter() - t_chunk0
+            jax.block_until_ready(values)
+            telemetry.on_step_time(
+                "l-bfgs", n, t_disp,
+                time.perf_counter() - t_chunk0 - t_disp)
         values = np.asarray(values)
         gnorms = np.asarray(gnorms)
         history.extend(float(v) for v in values)
@@ -184,7 +198,7 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
               maxiter: int = 1000, memory_size: int = 50,
               verbose: bool = True, chunk: int = 100, eager: bool = False,
               callback: Optional[Callable] = None,
-              callback_every: int = 0):
+              callback_every: int = 0, telemetry=None):
     """L-BFGS phase over network params with SA λ frozen
     (reference ``fit.py:60-89``).
 
@@ -204,9 +218,10 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
         fun, params, maxiter=maxiter, memory_size=memory_size,
         chunk=chunk, verbose=verbose, eager=eager,
         callback=callback, callback_every=callback_every,
-        args=(lam_bcs, lam_res, X_f, lam_data))
-    if verbose:
-        print(f"[l-bfgs] {len(history)} iters in {time.time() - t0:.1f}s, "
-              f"best loss {float(f_best):.3e} @ iter {int(i_best)}")
+        args=(lam_bcs, lam_res, X_f, lam_data), telemetry=telemetry)
+    log_event("l-bfgs",
+              f"{len(history)} iters in {time.time() - t0:.1f}s, "
+              f"best loss {float(f_best):.3e} @ iter {int(i_best)}",
+              verbose=verbose)
     loss_dicts = [{"Total Loss": v} for v in history]
     return x, tree_copy(x_best), f_best, i_best, loss_dicts

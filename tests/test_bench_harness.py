@@ -313,6 +313,27 @@ def test_remat_payload_edges(monkeypatch):
     assert "remat=False" in p["metric"] and "remat=True" not in p["metric"]
 
 
+def test_bench_telemetry_block():
+    """Every live worker payload embeds a telemetry block: step-time
+    breakdown (from the fenced timed loops) + memory peak + the shared
+    registry snapshot."""
+    bench = _load_bench()
+    from tensordiffeq_tpu import telemetry
+    reg = telemetry.default_registry()
+    reg.reset()
+    try:
+        bench._record_step_split(10, 0.5, 1.5)
+        block = bench.bench_telemetry_block()
+        assert "memory_peak_bytes" in block
+        st = block["step_time"]
+        key = "step_time_dispatch_s{phase=bench}"
+        assert key in st and st[key]["mean"] == 0.05
+        assert st["step_time_device_s{phase=bench}"]["mean"] == 0.15
+        assert block["metrics"]["histograms"][key]["count"] == 1
+    finally:
+        reg.reset()
+
+
 def test_serving_mode_registered():
     """--serving is a first-class mode: distinct cache artifact, a budget
     entry, and the --mode spelling maps onto it."""

@@ -1,0 +1,47 @@
+"""Lint-style guard: no bare ``print(`` calls in ``tensordiffeq_tpu/``.
+
+All package narration routes through ``telemetry.log_event`` (leveled,
+honours ``verbose``, mirrored into the active JSONL sink) so quiet runs
+are quiet and events are machine-readable.  The only places allowed to
+call ``print`` directly are the telemetry package itself (it implements
+the narration path) and ``training/progress.py`` (the tqdm-free progress
+bar, whose output is the progress UI, not narration).
+
+AST-based, so docstrings/comments mentioning print() don't false-positive.
+Fast (<1s) — runs in tier-1 as the CI check for this rule.
+"""
+
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tensordiffeq_tpu")
+
+# paths (relative to the package root) where print() stays legal
+ALLOWED = ("telemetry" + os.sep, os.path.join("training", "progress.py"))
+
+
+def _print_calls(path):
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return [node.lineno for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name) and node.func.id == "print"]
+
+
+def test_no_bare_print_outside_telemetry():
+    violations = []
+    for root, _dirs, files in os.walk(PKG):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, PKG)
+            if rel.startswith(ALLOWED[0]) or rel == ALLOWED[1]:
+                continue
+            for lineno in _print_calls(path):
+                violations.append(f"tensordiffeq_tpu/{rel}:{lineno}")
+    assert not violations, (
+        "bare print() calls found (route them through telemetry.log_event "
+        "so quiet runs stay quiet and events reach the JSONL sink):\n  "
+        + "\n  ".join(violations))

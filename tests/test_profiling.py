@@ -17,6 +17,37 @@ def test_timeit_returns_stats():
                                rtol=1e-6)
 
 
+def test_timeit_warmup_zero_and_single_iter():
+    """Edge cases made explicit: warmup=0 must not sync a never-computed
+    result (the old path fed None into block_until_ready without ever
+    calling fn), and iters=1 is a legal timing run."""
+    import jax
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return jnp.sin(x)
+
+    stats = tdq.profiling.timeit(jax.jit(f), jnp.arange(4.0),
+                                 iters=1, warmup=0)
+    assert stats["iters"] == 1
+    assert len(calls) == 1  # exactly one (timed) call — no hidden warmup
+    np.testing.assert_allclose(stats["result"], np.sin(np.arange(4.0)),
+                               rtol=1e-6)
+    # negative warmup behaves as zero
+    stats = tdq.profiling.timeit(jax.jit(f), jnp.arange(4.0),
+                                 iters=2, warmup=-3)
+    assert stats["iters"] == 2
+
+
+def test_timeit_rejects_non_positive_iters():
+    import pytest
+    with pytest.raises(ValueError):
+        tdq.profiling.timeit(lambda: None, iters=0)
+    with pytest.raises(ValueError):
+        tdq.profiling.timeit(lambda: None, iters=-1)
+
+
 def test_stopwatch_fills_elapsed():
     with tdq.profiling.stopwatch("unit", verbose=False) as sw:
         _ = jnp.ones(4).sum()

@@ -1,0 +1,320 @@
+"""Telemetry subsystem: registry semantics, JSONL round-trip + schema
+version, training instrumentation (NaN sentinel, λ stats, step-time),
+serving metrics landing in the shared registry, and the report renderer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import telemetry
+from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger,
+                                        TrainingDiverged, TrainingTelemetry)
+
+from test_solver import make_burgers
+
+
+# --------------------------------------------------------------------------- #
+# registry
+# --------------------------------------------------------------------------- #
+def test_counter_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("events")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("events").value == 5  # get-or-create returns same
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    reg.gauge("depth").set(3)
+    assert reg.gauge("depth").value == 3.0
+    # labels make distinct instruments; key format is deterministic
+    reg.counter("compiles", kind="u", bucket=256).inc()
+    reg.counter("compiles", bucket=256, kind="u").inc()  # same labels
+    reg.counter("compiles", kind="residual", bucket=256).inc()
+    d = reg.as_dict()
+    assert d["counters"]["compiles{bucket=256,kind=u}"] == 2
+    assert d["counters"]["compiles{bucket=256,kind=residual}"] == 1
+
+
+def test_histogram_streaming_and_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", reservoir=64)
+    xs = np.arange(10_000, dtype=np.float64)
+    h.observe_many(xs)
+    assert h.count == 10_000
+    assert h.min == 0.0 and h.max == 9999.0
+    assert h.sum == pytest.approx(xs.sum())
+    assert len(h._sample) == 64  # reservoir bounded
+    # percentile SEMANTICS are profiling.percentiles' (single-sourced)
+    assert h.percentiles() == tdq.profiling.percentiles(h._sample)
+    # empty histogram: the same None-for-empty contract
+    empty = reg.histogram("none")
+    assert empty.summary()["p99"] is None and empty.summary()["count"] == 0
+    # small exact case (reservoir not yet sampling): true percentiles
+    small = reg.histogram("small")
+    small.observe_many([1.0, 2.0, 3.0, 4.0])
+    assert small.summary()["p50"] == pytest.approx(2.5)
+    assert small.mean == pytest.approx(2.5)
+
+
+def test_scope_labels_merge():
+    reg = MetricsRegistry()
+    reg.scope(phase="adam").scope(host="h0").counter("steps").inc(2)
+    assert reg.as_dict()["counters"]["steps{host=h0,phase=adam}"] == 2
+    # inner label wins on conflict
+    reg.scope(phase="adam").counter("x", phase="lbfgs").inc()
+    assert "x{phase=lbfgs}" in reg.as_dict()["counters"]
+
+
+# --------------------------------------------------------------------------- #
+# run logger / JSONL
+# --------------------------------------------------------------------------- #
+def test_runlog_roundtrip_and_schema(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    reg.counter("things").inc(3)
+    with RunLogger(d, config={"n_f": 128}, registry=reg,
+                   run_id="run-test") as run:
+        run.event("epoch", phase="adam", epoch=0,
+                  losses={"Total Loss": np.float32(1.5)},
+                  arr=np.arange(3))
+        run.event("checkpoint", phase="adam", epoch=0)
+    man = telemetry.read_manifest(d)
+    assert man["schema_version"] == telemetry.SCHEMA_VERSION
+    assert man["run_id"] == "run-test"
+    assert man["config"] == {"n_f": 128}
+    assert man["n_events"] == 2
+    assert man["metrics"]["counters"]["things"] == 3  # snapshot on close
+    evs = telemetry.read_events(d)
+    assert [e["kind"] for e in evs] == ["epoch", "checkpoint"]
+    assert all(e["v"] == telemetry.SCHEMA_VERSION for e in evs)
+    # numpy payloads serialised to plain JSON types
+    assert evs[0]["losses"]["Total Loss"] == 1.5
+    assert evs[0]["arr"] == [0, 1, 2]
+    # kind filter
+    assert len(telemetry.read_events(d, kind="checkpoint")) == 1
+    # closed logger refuses further events
+    with pytest.raises(ValueError):
+        run.event("late")
+
+
+def test_runlog_truncated_line_skipped(tmp_path):
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="r") as run:
+        run.event("a", x=1)
+    # simulate a kill mid-write: truncated trailing line
+    with open(os.path.join(d, telemetry.EVENTS_FILE), "a") as fh:
+        fh.write('{"v": 1, "kind": "b", "x"')
+    evs = telemetry.read_events(d)
+    assert [e["kind"] for e in evs] == ["a"]
+
+
+def test_log_event_routing(tmp_path, capsys):
+    d = str(tmp_path / "run")
+    # no active logger + verbose: prints only
+    telemetry.log_event("fit", "hello world", verbose=True)
+    assert "[fit] hello world" in capsys.readouterr().out
+    with RunLogger(d, run_id="r"):
+        telemetry.log_event("fit", "quiet msg", verbose=False, extra=7)
+        telemetry.log_event("fit", "loud msg", verbose=True)
+        telemetry.log_event("l-bfgs", "warn msg", level="warning")
+    out = capsys.readouterr()
+    assert "quiet msg" not in out.out          # quiet runs are quiet
+    assert "[fit] loud msg" in out.out
+    assert "[l-bfgs] warn msg" in out.err      # warnings go to stderr
+    evs = telemetry.read_events(d)             # ... but everything is logged
+    assert [e.get("message") for e in evs] == ["quiet msg", "loud msg",
+                                               "warn msg"]
+    assert evs[0]["extra"] == 7
+    assert evs[2]["level"] == "warning"
+
+
+# --------------------------------------------------------------------------- #
+# training instrumentation
+# --------------------------------------------------------------------------- #
+def _sa_solver(n_f=256, lr=5e-3, lr_weights=5e-3):
+    domain, bcs, f_model = make_burgers(n_f=n_f, nx=16, nt=7)
+    rng = np.random.RandomState(0)
+    s = tdq.CollocationSolverND(verbose=False)
+    s.compile([2, 8, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+              dict_adaptive={"residual": [True],
+                             "BCs": [True, False, False]},
+              init_weights={"residual": [rng.rand(n_f, 1)],
+                            "BCs": [rng.rand(16, 1), None, None]},
+              lr=lr, lr_weights=lr_weights)
+    return s
+
+
+def test_toy_fit_produces_run_log_and_report(tmp_path):
+    d = str(tmp_path / "run")
+    s = _sa_solver()
+    with RunLogger(d, config={"example": "burgers-sa"}, run_id="toy") as run:
+        s.fit(tf_iter=40, newton_iter=20, chunk=20, telemetry=run)
+    # run config captured
+    cfg = telemetry.read_events(d, kind="run_config")
+    assert cfg and cfg[-1]["tf_iter"] == 40
+    # per-epoch loss components + gradient global-norm
+    epochs = telemetry.read_events(d, kind="epoch")
+    adam = [e for e in epochs if e["phase"] == "adam"]
+    assert len(adam) == 40
+    assert [e["epoch"] for e in adam] == list(range(40))
+    assert "Total Loss" in adam[0]["losses"]
+    assert "Residual_0" in adam[0]["losses"]
+    assert adam[0]["grad_norm"] is not None and adam[0]["grad_norm"] > 0
+    assert all(np.isfinite(e["losses"]["Total Loss"]) for e in adam)
+    lbfgs = [e for e in epochs if e["phase"] == "l-bfgs"]
+    assert lbfgs and "Total Loss" in lbfgs[0]["losses"]
+    # SA-λ distribution summaries at chunk cadence
+    lam = telemetry.read_events(d, kind="lambda_stats")
+    assert lam
+    stats = lam[-1]["stats"]
+    assert "residual[0]" in stats and "BCs[0]" in stats
+    assert set(stats["residual[0]"]) == {"min", "mean", "max", "p99"}
+    assert stats["residual[0]"]["min"] <= stats["residual[0]"]["p99"] \
+        <= stats["residual[0]"]["max"] + 1e-12
+    # step-time breakdown, block_until_ready-fenced
+    st = telemetry.read_events(d, kind="step_time")
+    assert st and all(e["dispatch_s"] >= 0 and e["device_s"] >= 0
+                      for e in st)
+    # fit end summary
+    assert telemetry.read_events(d, kind="fit_end")
+    # no divergence on a healthy run
+    assert not telemetry.read_events(d, kind="divergence")
+    # the report renders the diagnosis
+    text = telemetry.report(d)
+    assert "toy" in text
+    assert "no divergence" in text
+    assert "[adam]" in text and "grad global-norm" in text
+    assert "SA-λ" in text and "step-time" in text
+
+
+def test_nan_sentinel_fires_on_diverging_fit(tmp_path):
+    d = str(tmp_path / "run")
+    # deliberately broken config: an absurd learning rate overflows the
+    # float32 loss within a few steps
+    s = _sa_solver(lr=1e18, lr_weights=1e18)
+    with RunLogger(d, run_id="broken") as run:
+        with pytest.raises(TrainingDiverged) as ei:
+            s.fit(tf_iter=60, newton_iter=0, chunk=10, telemetry=run)
+    assert ei.value.phase == "adam"
+    assert ei.value.components  # the tripping loss dict rides along
+    div = telemetry.read_events(d, kind="divergence")
+    assert len(div) == 1
+    assert div[0]["phase"] == "adam"
+    # non-finite floats are written as strict-JSON-safe string tokens so
+    # jq/dashboard consumers can parse exactly these records
+    assert div[0]["components"]["Total Loss"] in ("NaN", "Infinity",
+                                                  "-Infinity")
+    assert "DIVERGED" in telemetry.report(d)
+    # the events file is strict JSON end to end (json.loads with
+    # parse_constant raising == no NaN/Infinity literals on any line)
+    import json
+
+    def _no_const(name):
+        raise AssertionError(f"non-strict JSON literal {name} in events")
+    with open(os.path.join(d, telemetry.EVENTS_FILE)) as fh:
+        for line in fh:
+            json.loads(line, parse_constant=_no_const)
+
+
+def test_sentinel_event_without_raise(tmp_path):
+    d = str(tmp_path / "run")
+    s = _sa_solver(lr=1e18, lr_weights=1e18)
+    with RunLogger(d, run_id="soft") as run:
+        tele = TrainingTelemetry(logger=run, raise_on_divergence=False)
+        s.fit(tf_iter=30, newton_iter=0, chunk=10, telemetry=tele)
+    assert telemetry.read_events(d, kind="divergence")
+    assert tele.registry.counter("divergences", phase="adam").value >= 1
+
+
+def test_quiet_solver_run_emits_no_stdout_but_logs(tmp_path, capsys):
+    """Satellite: verbose=False runs are actually quiet — narration goes
+    only to the sink."""
+    d = str(tmp_path / "run")
+    s = _sa_solver()
+    with RunLogger(d, run_id="q") as run:
+        s.fit(tf_iter=10, newton_iter=0, chunk=5, batch_sz=100,
+              telemetry=run)
+    out = capsys.readouterr().out
+    assert "[fit]" not in out  # batch_sz wrap narration silenced...
+    evs = telemetry.read_events(d, kind="fit")
+    assert any("wraps" in (e.get("message") or "") for e in evs)  # ...logged
+
+
+def test_telemetry_epoch_offset_rebases():
+    tele = TrainingTelemetry(logger=None, registry=MetricsRegistry())
+    recorded = []
+    tele.event = lambda kind, **f: recorded.append((kind, f))
+    tele.epoch_offset = 100
+    tele.on_epoch_rows("adam", 0, [{"Total Loss": 1.0}])
+    assert recorded[0][1]["epoch"] == 100
+
+
+# --------------------------------------------------------------------------- #
+# serving metrics land in the shared registry
+# --------------------------------------------------------------------------- #
+def test_serving_metrics_in_shared_registry():
+    reg = MetricsRegistry()
+    domain, bcs, f_model = make_burgers(n_f=128, nx=8, nt=5)
+    s = tdq.CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs)
+    engine = s.export_surrogate().engine(min_bucket=32, max_bucket=64,
+                                         registry=reg)
+    rng = np.random.RandomState(0)
+    engine.u(rng.rand(20, 2).astype(np.float32))   # compiles bucket 32
+    engine.u(rng.rand(20, 2).astype(np.float32))   # warm: no new compile
+    engine.u(rng.rand(60, 2).astype(np.float32))   # compiles bucket 64
+    d = reg.as_dict()
+    assert d["counters"]["serving.engine.compiles{bucket=32,kind=u}"] == 1
+    assert d["counters"]["serving.engine.compiles{bucket=64,kind=u}"] == 1
+    assert d["counters"]["serving.engine.points"] == 100
+    pad = d["histograms"]["serving.engine.pad_waste"]
+    assert pad["count"] == 3
+    assert pad["max"] == pytest.approx((32 - 20) / 32)
+
+    batcher = tdq.RequestBatcher(engine, max_batch=64, registry=reg)
+    for _ in range(6):
+        batcher.submit(rng.rand(4, 2).astype(np.float32))
+    depth = reg.gauge("serving.batcher.queue_depth").value
+    assert depth == 24  # live queue depth before flush
+    batcher.flush()
+    d = reg.as_dict()
+    assert d["gauges"]["serving.batcher.queue_depth"] == 0
+    assert d["counters"]["serving.batcher.requests"] == 6
+    assert d["counters"]["serving.batcher.batches"] == 1
+    assert d["counters"]["serving.batcher.points"] == 24
+    assert d["histograms"]["serving.batcher.batch_size"]["max"] == 24
+    assert d["histograms"]["serving.batcher.latency_s"]["count"] == 6
+    # the plain stats() contract is untouched
+    stats = batcher.stats()
+    assert stats["requests"] == 6 and stats["batches"] == 1
+
+
+def test_serving_defaults_to_shared_default_registry():
+    domain, bcs, f_model = make_burgers(n_f=64, nx=8, nt=5)
+    s = tdq.CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs)
+    engine = s.export_surrogate().engine(min_bucket=32, max_bucket=32)
+    assert engine._metrics is telemetry.default_registry()
+    b = tdq.RequestBatcher(engine)
+    assert b._metrics is telemetry.default_registry()
+
+
+# --------------------------------------------------------------------------- #
+# JSONL manifest sanity for a batcher-failure path
+# --------------------------------------------------------------------------- #
+def test_batcher_failure_counts_in_registry():
+    reg = MetricsRegistry()
+
+    def bad_op(X):
+        raise RuntimeError("boom")
+
+    b = tdq.RequestBatcher(op=bad_op, max_batch=1024, registry=reg)
+    h = b.submit(np.zeros((2, 2), np.float32))
+    with pytest.raises(RuntimeError):
+        b.flush()
+    with pytest.raises(RuntimeError):
+        h.result()
+    assert reg.as_dict()["counters"]["serving.batcher.failed"] == 1

@@ -958,13 +958,38 @@ def bench_serving(n_f, nx, nt, widths, on_phase=None):
     for b in engine.bucket_sizes:
         if b <= max_batch:
             engine.u(draw(b))
+    from tensordiffeq_tpu.resilience import active_chaos
+    chaos = active_chaos()
+    resilience_kw = {}
+    if chaos is not None:
+        # under --chaos the batcher runs the full self-healing stack, so
+        # the QPS delta vs the clean capture PRICES the recovery overhead
+        from tensordiffeq_tpu.resilience import CircuitBreaker, RetryPolicy
+        resilience_kw = dict(
+            retry=RetryPolicy(max_attempts=4, base_delay_s=1e-3,
+                              max_delay_s=1e-2),
+            breaker=CircuitBreaker(failure_threshold=8,
+                                   reset_timeout_s=0.05),
+            request_timeout_s=10.0)
     batcher = RequestBatcher(engine, max_batch=max_batch,
-                             max_latency_s=0.005)
+                             max_latency_s=0.005, **resilience_kw)
+    # under chaos, only the resilience machinery's own outcomes are
+    # tolerable (an injected fault that out-lived its retries, a breaker
+    # fast-fail) — they are counted in stats; an ORGANIC failure still
+    # aborts the measurement either way
+    from tensordiffeq_tpu.resilience import ChaosFault, CircuitOpenError
+    tolerated = (ChaosFault, CircuitOpenError) if chaos is not None else ()
     sizes = rng.randint(1, 33, size=n_req)
     for s in sizes:
-        batcher.submit(draw(int(s)))
-        batcher.poll()
-    batcher.flush()
+        try:
+            batcher.submit(draw(int(s)))
+            batcher.poll()
+        except tolerated:
+            pass
+    try:
+        batcher.flush()
+    except tolerated:
+        pass
     stats = batcher.stats()
     payload.update(
         value=(None if stats["qps"] is None
@@ -975,7 +1000,11 @@ def bench_serving(n_f, nx, nt, widths, on_phase=None):
                    for k, v in stats["latency_s"].items()},
         compile_cache_programs=engine.compile_cache_size,
         # the batcher serves engine.u, so only two kinds ever compile here
-        compile_cache_bound=2 * engine.n_buckets)
+        compile_cache_bound=2 * engine.n_buckets,
+        # self-healing tallies (all zero on a clean run; under --chaos the
+        # retried_ok count is the faults that healed invisibly)
+        serving_health={k: stats[k] for k in
+                        ("failed", "timed_out", "rejected", "retried_ok")})
     log(f"[serving] {stats['requests']} requests in {stats['batches']} "
         f"batches -> {stats['qps']:,.0f} QPS, "
         f"p99={stats['latency_s']['p99']:.4f}s, "
@@ -1118,6 +1147,13 @@ def worker_main(args):
     if args.force_cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    chaos = None
+    if getattr(args, "chaos", None):
+        from tensordiffeq_tpu.resilience import Chaos
+        chaos = Chaos.from_spec(args.chaos)
+        chaos.__enter__()  # worker-lifetime scope (process exits after)
+        log(f"[chaos] fault injection active: {chaos.spec()}")
 
     fast = os.environ.get("BENCH_FAST") == "1"
     n_f = int(os.environ.get("BENCH_NF", 2048 if fast else 50_000))
@@ -1275,6 +1311,11 @@ def worker_main(args):
     payload.setdefault("backend", jax.default_backend())
     payload.setdefault("device_kind", jax.devices()[0].device_kind)
     payload.setdefault("captured", time.strftime("%Y-%m-%d"))
+    if chaos is not None:
+        # what was injected and what actually fired: the denominator for
+        # the recovery-overhead read of the telemetry block below
+        payload["chaos"] = {"spec": chaos.spec(),
+                            "fired": dict(chaos.fired)}
     try:
         payload.setdefault("telemetry", bench_telemetry_block())
     except Exception as e:  # observability must never cost a measurement
@@ -1535,6 +1576,14 @@ def main():
                                        "serving"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
+    ap.add_argument("--chaos", metavar="SPEC",
+                    help="activate deterministic fault injection for the "
+                         "worker run (tensordiffeq_tpu.resilience.Chaos "
+                         "spec, e.g. 'serving_fail_rate=0.2,seed=1'): "
+                         "prices recovery overhead — retry/breaker/"
+                         "recovery counters ride in the payload's "
+                         "telemetry block.  Chaos payloads are never "
+                         "promoted to the TPU scoreboard cache.")
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--force-cpu", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -1565,13 +1614,18 @@ def main():
     attempt_cap = float(os.environ.get("BENCH_TIMEOUT", budget))
 
     diag = []
+    # chaos flags ride to the worker but never into the cache key: a
+    # fault-injected measurement must not become the cached good payload
+    chaos_flags = ["--chaos", args.chaos] if args.chaos else []
+
     backend = probe_backend(min(PROBE_TIMEOUT, max(10.0, remaining() - 30)))
     if backend and backend != "cpu":
         to = min(attempt_cap, remaining() - RESERVE_S)
         if to > 30:
-            payload, err = run_worker(mode_flags, to)
+            payload, err = run_worker(mode_flags + chaos_flags, to)
             if payload is not None:
-                save_tpu_cache(mode_flags, payload)
+                if not args.chaos:
+                    save_tpu_cache(mode_flags, payload)
                 if diag:
                     payload["diag"] = diag
                 print(json.dumps(payload))
@@ -1626,7 +1680,8 @@ def main():
     if to > 60:
         env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                    BENCH_ENGINE="")
-        payload, err = run_worker(mode_flags + ["--force-cpu"], to, env=env)
+        payload, err = run_worker(mode_flags + chaos_flags + ["--force-cpu"],
+                                  to, env=env)
     else:
         err = "no budget left for a CPU fallback"
     if payload is not None:

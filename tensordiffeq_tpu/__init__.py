@@ -31,7 +31,7 @@ Quick start (Burgers)::
 from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
 from . import parallel, plotting, profiling, sampling, telemetry  # noqa: F401
-from . import training, utils  # noqa: F401
+from . import resilience, training, utils  # noqa: F401
 from . import models, serving  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
@@ -42,6 +42,8 @@ from .networks import (MLP, FourierMLP, PeriodicMLP, fourier_net,  # noqa: F401
                        neural_net, periodic_net)
 from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
+from .resilience import (Chaos, CircuitBreaker, Preempted,  # noqa: F401
+                         PreemptionHandler, ResilientFit, RetryPolicy)
 from .serving import InferenceEngine, RequestBatcher, Surrogate  # noqa: F401
 from .telemetry import (MetricsRegistry, RunLogger,  # noqa: F401
                         TrainingDiverged, TrainingTelemetry)

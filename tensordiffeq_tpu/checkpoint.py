@@ -18,23 +18,113 @@ Both are behind the same two functions, keyed by a directory path::
 ``template`` must be a pytree with the same structure/shapes as the saved
 state (build it from a freshly compiled solver, as
 ``CollocationSolverND.restore_checkpoint`` does).
+
+Crash-safety protocol (what a preemptible environment actually needs):
+
+* every save lands in a ``<path>.tmp`` sibling first, every payload file
+  is **fsynced**, and the directory swaps in via atomic renames — a
+  process killed at ANY point leaves a restorable checkpoint on disk;
+* the previous checkpoint is **retained** at ``<path>.old`` (keep-last
+  K=2), not discarded: rollback and corruption fallback both need a
+  second intact generation;
+* the meta file embeds a **content checksum** over every payload byte;
+  :func:`restore_checkpoint` validates it (and the pytree shapes) before
+  trusting a generation, and falls back to ``<path>.old`` when the newest
+  is torn/corrupt — raising :class:`CheckpointCorrupted` only when no
+  generation survives.  ``tests/test_resilience.py`` drives this with
+  chaos-torn writes.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
+
+from .telemetry import log_event
 
 _META = "tdq_meta.json"
 _FLAX_FILE = "state.msgpack"
 
 
+class TemplateMismatch(ValueError):
+    """The caller's template does not match what this checkpoint holds —
+    a CONFIG error (wrong layer sizes, different λ setup), not corruption.
+    Never absorbed by the previous-generation fallback: silently resuming
+    an older run would be worse than the error."""
+
+
+class CheckpointCorrupted(RuntimeError):
+    """No checkpoint generation under this path survived validation.
+    ``failures`` maps each candidate directory to why it was rejected."""
+
+    def __init__(self, path: str, failures: dict):
+        self.path = path
+        self.failures = dict(failures)
+        detail = "; ".join(f"{d}: {why}" for d, why in failures.items())
+        super().__init__(
+            f"every checkpoint generation under {path} failed validation "
+            f"({detail})")
+
+
 def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _payload_files(path: str) -> list:
+    """Every file under ``path`` except the meta, as sorted relative paths
+    — the checksum domain (sorted so the digest is walk-order independent)."""
+    out = []
+    for root, _, files in os.walk(path):
+        for f in files:
+            if f == _META:
+                continue
+            out.append(os.path.relpath(os.path.join(root, f), path))
+    return sorted(out)
+
+
+def _digest_dir(path: str) -> dict:
+    """Content checksum over every payload byte (file names included, so a
+    missing or renamed file also fails validation)."""
+    h = hashlib.sha256()
+    files = _payload_files(path)
+    for rel in files:
+        h.update(rel.encode("utf-8"))
+        h.update(b"\x00")
+        with open(os.path.join(path, rel), "rb") as fh:
+            for block in iter(lambda: fh.read(1 << 20), b""):
+                h.update(block)
+    return {"algo": "sha256", "digest": h.hexdigest(), "n_files": len(files)}
+
+
+def _fsync_dir_tree(path: str) -> None:
+    """fsync every file under ``path`` plus the directories themselves —
+    the rename-based swap below is only crash-atomic if the payload bytes
+    reached disk first.  Best-effort on filesystems without dir fsync."""
+    for root, dirs, files in os.walk(path):
+        for f in files:
+            with open(os.path.join(root, f), "rb") as fh:
+                os.fsync(fh.fileno())
+        try:
+            fd = os.open(root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+
+def checkpoint_exists(path: str) -> bool:
+    """Is there any restorable generation (current, parked, or previous)
+    under ``path``?"""
+    path = os.path.abspath(path)
+    return any(os.path.exists(os.path.join(c, _META))
+               for c in (path, path + ".old"))
 
 
 def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
@@ -44,14 +134,16 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
     history, iteration counters, …).
 
     The write is crash-safe: everything lands in a ``<path>.tmp`` sibling
-    first, then swaps in via directory renames.  A process killed at ANY
-    point leaves a restorable checkpoint on disk — either the new one, or
-    the previous one (possibly parked at ``<path>.old``, which
-    :func:`restore_checkpoint` falls back to).  This matters because the
-    mid-run checkpoint hook (``fit(checkpoint_dir=)``) exists precisely
-    for environments that kill processes at arbitrary moments; an
-    overwrite-in-place would put the only resume point in the blast
-    radius of every periodic save.
+    first (payloads fsynced, content checksum embedded in the meta), then
+    swaps in via directory renames.  A process killed at ANY point leaves
+    a restorable checkpoint on disk — either the new one, or the previous
+    one (parked at ``<path>.old``, which :func:`restore_checkpoint` falls
+    back to).  The parked generation is KEPT (last K=2): it is both the
+    mid-swap-kill safety net and the fallback when the newest generation
+    is later found corrupt.  This matters because the mid-run checkpoint
+    hook (``fit(checkpoint_dir=)``) exists precisely for environments that
+    kill processes at arbitrary moments; an overwrite-in-place would put
+    the only resume point in the blast radius of every periodic save.
     """
     import shutil
 
@@ -72,9 +164,20 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
         with open(os.path.join(tmp, _FLAX_FILE), "wb") as fh:
             fh.write(flax.serialization.to_bytes(state))
     with open(os.path.join(tmp, _META), "w") as fh:
-        json.dump({"backend": backend, "meta": meta or {}}, fh)
-    # swap: park the previous checkpoint, promote the new one, then drop
-    # the parked copy.  Both renames are atomic on POSIX.  Only clear a
+        json.dump({"backend": backend, "meta": meta or {},
+                   # restores compare these against the caller's template
+                   # BEFORE any backend load, so a wrong-config restore is
+                   # diagnosed as TemplateMismatch (and never triggers the
+                   # corruption fallback) regardless of which backend error
+                   # a mismatched deserialisation would otherwise raise
+                   "leaf_shapes": [list(np.shape(leaf)) for leaf in
+                                   jax.tree_util.tree_leaves(state)],
+                   "checksum": _digest_dir(tmp)}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    _fsync_dir_tree(tmp)
+    # swap: park the previous checkpoint, promote the new one, KEEP the
+    # parked copy (K=2).  Both renames are atomic on POSIX.  Only clear a
     # stale ``.old`` when there is a current ``path`` to park in its place:
     # if a prior save died mid-swap, ``.old`` holds the ONLY restorable
     # checkpoint until the rename below promotes ``tmp``.
@@ -82,7 +185,10 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
         shutil.rmtree(old, ignore_errors=True)
         os.rename(path, old)
     os.rename(tmp, path)
-    shutil.rmtree(old, ignore_errors=True)
+    from .resilience.chaos import active_chaos
+    c = active_chaos()
+    if c is not None:
+        c.on_checkpoint_saved(path)
 
 
 def resolve_checkpoint_dir(path: str) -> str:
@@ -96,16 +202,51 @@ def resolve_checkpoint_dir(path: str) -> str:
     return path
 
 
-def restore_checkpoint(path: str, template: dict) -> tuple[dict, dict]:
-    """Load the state saved under ``path``.  ``template`` provides the pytree
-    structure (and, for the orbax path, shape/dtype guidance).  Returns
-    ``(state, meta)``.
-
-    If ``path`` is missing but a ``<path>.old`` sibling exists (a save was
-    killed mid-swap), the parked previous checkpoint is restored instead."""
-    path = resolve_checkpoint_dir(path)
+def verify_checkpoint(path: str) -> None:
+    """Validate one checkpoint directory's content checksum against its
+    meta.  Raises ``ValueError`` on mismatch (and ``OSError`` when files
+    are missing); checkpoints from before the checksum era pass (nothing
+    recorded to validate against)."""
     with open(os.path.join(path, _META)) as fh:
         info = json.load(fh)
+    want = info.get("checksum")
+    if want is None:
+        return
+    got = _digest_dir(path)
+    if got["digest"] != want.get("digest"):
+        raise ValueError(
+            f"content checksum mismatch ({got['n_files']} files, "
+            f"{got['digest'][:12]}… != recorded {str(want.get('digest'))[:12]}…)"
+            " — torn or corrupted write")
+
+
+def _template_shape_check(saved_shapes, template) -> None:
+    t_shapes = [tuple(np.shape(leaf))
+                for leaf in jax.tree_util.tree_leaves(_to_host(template))]
+    saved = [tuple(s) for s in saved_shapes]
+    if len(saved) != len(t_shapes):
+        raise TemplateMismatch(
+            f"checkpoint has {len(saved)} array leaves but the template "
+            f"has {len(t_shapes)}; was this checkpoint saved for a "
+            "different configuration?")
+    for t, s in zip(t_shapes, saved):
+        if t != s:
+            raise TemplateMismatch(
+                f"checkpoint leaf shape {s} does not match the template's "
+                f"{t}; was this checkpoint saved for a different "
+                "configuration?")
+
+
+def _restore_one(path: str, template: dict) -> tuple[dict, dict]:
+    """Load + validate a single checkpoint directory (no fallback)."""
+    verify_checkpoint(path)
+    with open(os.path.join(path, _META)) as fh:
+        info = json.load(fh)
+    if "leaf_shapes" in info:
+        # config-vs-corruption triage BEFORE the backend load: a template
+        # that cannot match raises TemplateMismatch here, so a backend
+        # deserialisation error below really does mean a damaged payload
+        _template_shape_check(info["leaf_shapes"], template)
     if info["backend"] == "orbax":
         import orbax.checkpoint as ocp
         ckptr = ocp.StandardCheckpointer()
@@ -117,12 +258,52 @@ def restore_checkpoint(path: str, template: dict) -> tuple[dict, dict]:
             state = flax.serialization.from_bytes(template, fh.read())
     # orbax will happily hand back whatever shapes were saved — validate
     # against the template so a wrong-config restore fails loudly here
-    t_leaves = jax.tree_util.tree_leaves(_to_host(template))
-    s_leaves = jax.tree_util.tree_leaves(state)
-    for t, s in zip(t_leaves, s_leaves):
-        if tuple(np.shape(t)) != tuple(np.shape(s)):
-            raise ValueError(
-                f"checkpoint leaf shape {np.shape(s)} does not match the "
-                f"template's {np.shape(t)}; was this checkpoint saved for a "
-                "different configuration?")
+    # (covers pre-leaf_shapes-era checkpoints; newer ones were already
+    # triaged above)
+    _template_shape_check([np.shape(s) for s in
+                           jax.tree_util.tree_leaves(state)], template)
     return state, info["meta"]
+
+
+def restore_checkpoint(path: str, template: dict) -> tuple[dict, dict]:
+    """Load the state saved under ``path``.  ``template`` provides the pytree
+    structure (and, for the orbax path, shape/dtype guidance).  Returns
+    ``(state, meta)``.
+
+    Restore order: the current generation, then the parked previous one
+    (``<path>.old`` — present after a killed mid-swap save AND, since the
+    keep-last-2 protocol, after every completed save).  A generation whose
+    content checksum fails, whose files are missing, or whose payload
+    cannot be deserialised is skipped with a logged warning instead of
+    crashing the restore; only when NO generation survives does
+    :class:`CheckpointCorrupted` raise.
+
+    Template-shape mismatches are NOT absorbed by the fallback: the newest
+    generation deserialising cleanly into the wrong shapes means the
+    caller compiled a different configuration — that error propagates
+    (falling back would silently resume from an older run).
+    """
+    path = os.path.abspath(path)
+    candidates = [c for c in (path, path + ".old")
+                  if os.path.exists(os.path.join(c, _META))]
+    if not candidates:
+        raise FileNotFoundError(
+            f"no checkpoint meta under {path} (or its .old sibling)")
+    failures: dict = {}
+    for i, cand in enumerate(candidates):
+        try:
+            state, meta = _restore_one(cand, template)
+        except TemplateMismatch:
+            raise  # config mismatch, not corruption — never fall back
+        except Exception as e:
+            failures[cand] = f"{type(e).__name__}: {e}"
+        else:
+            if i > 0 or failures:
+                log_event("checkpoint",
+                          f"restored the previous generation {cand} "
+                          f"(newer one rejected: "
+                          f"{'; '.join(failures.values()) or 'missing'})",
+                          level="warning", verbose=True, restored=cand,
+                          failures=failures)
+            return state, meta
+    raise CheckpointCorrupted(path, failures)

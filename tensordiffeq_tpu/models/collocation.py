@@ -679,7 +679,7 @@ class CollocationSolverND:
             resample_seed: int = 0,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0,
-            telemetry=None):
+            telemetry=None, grad_clip: Optional[float] = None):
         """Adam phase then L-BFGS refinement (reference ``models.py:227`` →
         ``fit.py:17-102``).
 
@@ -732,7 +732,21 @@ class CollocationSolverND:
         and the NaN/Inf sentinel raises a structured
         :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged` instead of
         letting a poisoned history run to the end.  Render the resulting
-        run directory with :func:`tensordiffeq_tpu.telemetry.report`."""
+        run directory with :func:`tensordiffeq_tpu.telemetry.report`.
+
+        ``grad_clip`` (beyond-reference;
+        :mod:`tensordiffeq_tpu.resilience`): global-norm gradient clipping
+        inside the Adam optimizer — the divergence-recovery remedy rung
+        :class:`~tensordiffeq_tpu.resilience.ResilientFit` threads through
+        here.  Toggling it changes the optimizer-state pytree, so a resume
+        across the toggle restarts the Adam moments (checkpoint meta
+        records the active value so restores build a matching template).
+
+        Preemption (:mod:`tensordiffeq_tpu.resilience.preemption`): a
+        pending SIGTERM/SIGINT request — or an injected chaos preemption —
+        is noticed at the next chunk boundary of either phase; the final
+        state is flushed through the ``checkpoint_dir`` hook and
+        :class:`~tensordiffeq_tpu.resilience.Preempted` is raised."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before fit(...)")
         if profile_dir is not None:
@@ -749,7 +763,7 @@ class CollocationSolverND:
                                 resample_temp=resample_temp,
                                 resample_uniform=resample_uniform,
                                 resample_seed=resample_seed,
-                                telemetry=telemetry)
+                                telemetry=telemetry, grad_clip=grad_clip)
         tele = as_training_telemetry(telemetry)
         epochs_at_entry = len(self.losses)
         if tele is not None:
@@ -870,7 +884,10 @@ class CollocationSolverND:
                         # (the loss history counts only Adam epochs
                         # until the phase returns)
                         "newton_done": int(newton_done),
-                        "has_opt_state": opt_state is not None}
+                        "has_opt_state": opt_state is not None,
+                        # restores rebuild the opt_state template with the
+                        # same clipping config, or the pytrees won't match
+                        "grad_clip": grad_clip}
                 if cand:
                     bl, bi, ph, bp = min(cand, key=lambda c: c[0])
                     state["best_params"] = bp
@@ -891,12 +908,15 @@ class CollocationSolverND:
             freeze = getattr(self, "use_ntk", False)
             if self.opt_state is not None and not opt_state_matches(
                     make_optimizer(self.lr, self.lr_weights,
-                                   freeze_lambdas=freeze),
+                                   freeze_lambdas=freeze,
+                                   grad_clip=grad_clip),
                     {"params": self.params, "lambdas": lambdas},
                     self.opt_state):
                 # solver-managed state can go stale (e.g. λ rows trimmed by
-                # dist sharding); restart the moments rather than erroring
+                # dist sharding, or grad_clip toggled by a recovery rung);
+                # restart the moments rather than erroring
                 self.opt_state = None
+            self._opt_grad_clip = grad_clip  # save_checkpoint records this
             ntk_update = self._ntk_fn
             if self._ntk_fn is not None and resample_fn is not None:
                 # only when resampling: thread the LIVE collocation subsample
@@ -985,7 +1005,8 @@ class CollocationSolverND:
                     resample_fn=res_fn,
                     resample_every=resample_every,
                     state_hook=hook, state_hook_every=checkpoint_every,
-                    stop_fn=stop_fn, telemetry=tele)
+                    stop_fn=stop_fn, telemetry=tele, grad_clip=grad_clip,
+                    epoch0=epochs_at_entry + off)
                 self.params = trainables["params"]
                 self.lambdas = lambdas = trainables["lambdas"]
                 result.wall_time["adam"] += wall_before
@@ -1050,12 +1071,27 @@ class CollocationSolverND:
                         and prev // eval_every != i // eval_every:
                     eval_fn("l-bfgs", i, p)
 
+            preempt_flush = None
+            if ckpt_hook is not None:
+                def preempt_flush(i, p, best):
+                    # unconditional final flush (the cadence-gated
+                    # lb_callback may have skipped this boundary); same
+                    # re-basing as the periodic checkpoint path
+                    ckpt_hook({"params": p, "lambdas": self.lambdas},
+                              self.opt_state, i,
+                              newton_done=newton_prior + i,
+                              best=(None if best is None else
+                                    (best[0], best[1],
+                                     newton_prior + int(best[2]))),
+                              phase="l-bfgs")
+
             params, best_params, best_loss, best_iter, lbfgs_losses = fit_lbfgs(
                 self.loss_fn_refine, self.params, self.lambdas, self.X_f,
                 maxiter=newton_iter, verbose=self.verbose,
                 eager=bool(newton_eager),
                 callback=(lb_callback if lb_every > 0 else None),
-                callback_every=lb_every, telemetry=tele)
+                callback_every=lb_every, telemetry=tele,
+                iter0=newton_prior, preempt_flush=preempt_flush)
             self.params = params
             self.losses.extend(lbfgs_losses)
             if tele is not None:
@@ -1158,7 +1194,8 @@ class CollocationSolverND:
                 "min_loss": {k: float(v) for k, v in self.min_loss.items()},
                 "best_epoch": dict(self.best_epoch),
                 "newton_done": int(getattr(self, "newton_done", 0)),
-                "has_opt_state": self.opt_state is not None}
+                "has_opt_state": self.opt_state is not None,
+                "grad_clip": getattr(self, "_opt_grad_clip", None)}
         # carry the best iterate too, so predict(best_model=True) survives
         # a save/restore cycle (phase buckets tie-break before "overall",
         # which always mirrors one of them — restores re-bucket by phase)
@@ -1208,7 +1245,8 @@ class CollocationSolverND:
             _meta_peek = _json.load(fh)["meta"]
         if _meta_peek.get("has_opt_state", False):
             opt = make_optimizer(self.lr, self.lr_weights,
-                                 freeze_lambdas=getattr(self, "use_ntk", False))
+                                 freeze_lambdas=getattr(self, "use_ntk", False),
+                                 grad_clip=_meta_peek.get("grad_clip"))
             template["opt_state"] = opt.init(
                 {"params": self.params, "lambdas": self.lambdas})
         if _meta_peek.get("has_best", False):
@@ -1217,6 +1255,9 @@ class CollocationSolverND:
         self.params = state["params"]
         self.lambdas = state["lambdas"]
         self.opt_state = state.get("opt_state")
+        # the restored moments carry this clipping config; a fit() with a
+        # different grad_clip restarts them (see the stale-state check)
+        self._opt_grad_clip = _meta_peek.get("grad_clip")
         if mesh is not None:
             # restored λ come back host-resident; re-apply the data-parallel
             # placement so per-point λ resume sharded alongside their points

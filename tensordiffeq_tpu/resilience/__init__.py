@@ -1,0 +1,49 @@
+"""Resilience: fault injection, divergence recovery, preemption-safe
+training, and self-healing serving.
+
+The north-star system runs for weeks on preemptible accelerators and
+serves heavy traffic — at that scale preemption, transient device
+failures, and training divergence are ROUTINE, not exceptional.  This
+package turns each from run-ending into recoverable, and (crucially)
+makes every recovery path testable on CPU:
+
+* :mod:`~tensordiffeq_tpu.resilience.chaos` — deterministic, seedable
+  fault injection (:class:`Chaos`): NaN gradients at epoch N, simulated
+  preemptions and device errors at step boundaries, torn checkpoint
+  writes, serving-op failures at a configured rate.  Scoped (context
+  manager) or process-wide (``TDQ_CHAOS`` env).  Zero overhead when off.
+* :mod:`~tensordiffeq_tpu.resilience.recovery` — :class:`ResilientFit`:
+  catches :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`, rolls
+  back to the last good checkpoint, applies a remedy ladder (LR backoff
+  -> SA-λ reset -> gradient clipping), retries within a budget.
+* :mod:`~tensordiffeq_tpu.resilience.preemption` — SIGTERM/SIGINT ->
+  final checkpoint flush inside a deadline, :class:`Preempted` +
+  :data:`RESUMABLE_EXIT_CODE` (75), and :func:`auto_resume` (state the
+  TOTAL budgets; bookkeeping is automatic).
+* :mod:`~tensordiffeq_tpu.resilience.retry` /
+  :mod:`~tensordiffeq_tpu.resilience.breaker` — the serving path's
+  transient/sustained failure answers: :class:`RetryPolicy` exponential
+  backoff with deterministic jitter, and :class:`CircuitBreaker`
+  fast-fail with half-open probing.  Wired into
+  :class:`~tensordiffeq_tpu.serving.RequestBatcher` (op retries,
+  per-request deadlines — no hung waiters) and
+  :class:`~tensordiffeq_tpu.serving.InferenceEngine` (per-bucket compile
+  quarantine).
+
+Everything reports through the PR-4 telemetry layer (``rollback`` /
+``remedy`` / ``preempt`` / ``resume`` / ``retry`` / ``breaker`` events +
+``resilience.*`` metrics), and ``telemetry.report`` narrates what failed
+and what healed.
+"""
+
+from .breaker import (CLOSED, HALF_OPEN, OPEN,  # noqa: F401
+                      CircuitBreaker, CircuitOpenError)
+from .chaos import (Chaos, ChaosDeviceError, ChaosFault,  # noqa: F401
+                    ChaosServingError, active_chaos)
+from .preemption import (RESUMABLE_EXIT_CODE, Preempted,  # noqa: F401
+                         PreemptionHandler, auto_resume, clear_preemption,
+                         default_checkpoint_dir, handle_preemption,
+                         is_resumable_exit, preemption_requested,
+                         request_preemption)
+from .recovery import ResilientFit  # noqa: F401
+from .retry import RetryPolicy, retry_call  # noqa: F401

@@ -1,0 +1,317 @@
+"""Deterministic, seedable fault injection — the test harness for every
+recovery path in this package.
+
+A production PINN service sees faults that are nearly impossible to
+reproduce on demand: a NaN gradient 40k epochs into a self-adaptive run, a
+preemption signal mid-chunk, a checkpoint torn by a dying node, a serving
+op that fails transiently under load.  :class:`Chaos` makes each of those
+injectable **deterministically** (seeded RNG, fire-counted triggers) so the
+recovery machinery — :class:`~tensordiffeq_tpu.resilience.ResilientFit`,
+the preemption handler, checkpoint fallback, serving retry/breaker — is
+exercised by fast CPU tests instead of trusted on faith.
+
+Activation is scoped (context manager) or process-wide (``TDQ_CHAOS`` env
+var, same ``key=value,key=value`` spec)::
+
+    with Chaos(nan_epoch=60, seed=0):
+        ResilientFit(solver, ckpt).fit(tf_iter=200)
+
+    TDQ_CHAOS="serving_fail_rate=0.3,seed=1" python serve.py
+
+Every injection point is a no-op when no chaos is active: the hooks reduce
+to one ``_STACK``-empty check (see ``active_chaos``), so production runs
+pay nothing — ``tests/test_resilience.py`` pins fit results bit-identical
+with and without the wiring.
+
+Faults and where they fire:
+
+* ``nan_epoch`` — at the first Adam chunk boundary past this (absolute)
+  epoch, the network params are overwritten with NaN: the next chunk's
+  losses go non-finite exactly as a real gradient blow-up propagates, so
+  the telemetry sentinel raises a genuine
+  :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`.  ``nan_repeats``
+  re-arms the trigger (a rolled-back retry re-crosses the epoch), driving
+  multiple rungs of a remedy ladder.
+* ``preempt_epoch`` — requests a graceful preemption (same flag a real
+  SIGTERM sets), so training flushes a final checkpoint and raises
+  :class:`~tensordiffeq_tpu.resilience.Preempted` at the boundary.
+* ``device_error_epoch`` — raises :class:`ChaosDeviceError` at the
+  boundary with NO graceful flush: the hard-kill path (resume must come
+  from the last periodic checkpoint).
+* ``torn_checkpoint_nth`` — corrupts the Nth checkpoint written while
+  active, *after* it was atomically promoted: simulates storage-level
+  corruption that the checksum validation + previous-checkpoint fallback
+  in :mod:`tensordiffeq_tpu.checkpoint` must absorb.
+* ``serving_fail_n`` / ``serving_fail_rate`` — serving ops fail with
+  :class:`ChaosServingError`: the first ``n`` deterministically, then at
+  ``rate`` per the seeded RNG (drives batcher retry + circuit breaker).
+* ``compile_fail_buckets`` — first-touch compiles of these engine bucket
+  sizes raise: drives the per-bucket quarantine path in
+  :class:`~tensordiffeq_tpu.serving.InferenceEngine`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..telemetry import log_event
+
+_ENV_VAR = "TDQ_CHAOS"
+
+
+class ChaosFault(RuntimeError):
+    """Base class of every injected fault (so supervisors can tell an
+    injected fault from an organic one when both are possible)."""
+
+
+class ChaosServingError(ChaosFault):
+    """Injected transient serving-op failure (retryable)."""
+
+
+class ChaosDeviceError(ChaosFault):
+    """Injected hard device error at a training step boundary (NOT
+    graceful: no final checkpoint is flushed)."""
+
+
+class Chaos:
+    """One fault-injection plan: config + seeded RNG + fire counters.
+
+    Use as a context manager to scope injection to a block; nested scopes
+    resolve to the innermost.  All epoch triggers are **absolute** run
+    epochs (offsets are threaded through the training loop), so a plan
+    stays meaningful across rollback/resume legs; each trigger fires on
+    the first boundary at-or-past its epoch and then re-arms up to its
+    ``*_repeats`` budget (default 1 = fire once, ever).
+    """
+
+    def __init__(self, *, seed: int = 0,
+                 nan_epoch: Optional[int] = None, nan_repeats: int = 1,
+                 preempt_epoch: Optional[int] = None, preempt_repeats: int = 1,
+                 device_error_epoch: Optional[int] = None,
+                 device_error_repeats: int = 1,
+                 torn_checkpoint_nth: Optional[int] = None,
+                 serving_fail_n: int = 0, serving_fail_rate: float = 0.0,
+                 compile_fail_buckets: Sequence[int] = ()):
+        if not 0.0 <= float(serving_fail_rate) <= 1.0:
+            raise ValueError(
+                f"serving_fail_rate must be in [0, 1], got {serving_fail_rate}")
+        self.seed = int(seed)
+        self.nan_epoch = nan_epoch
+        self.nan_repeats = int(nan_repeats)
+        self.preempt_epoch = preempt_epoch
+        self.preempt_repeats = int(preempt_repeats)
+        self.device_error_epoch = device_error_epoch
+        self.device_error_repeats = int(device_error_repeats)
+        self.torn_checkpoint_nth = torn_checkpoint_nth
+        self.serving_fail_n = int(serving_fail_n)
+        self.serving_fail_rate = float(serving_fail_rate)
+        self.compile_fail_buckets = tuple(int(b) for b in compile_fail_buckets)
+        self._rng = np.random.RandomState(self.seed)
+        # fire bookkeeping (all monotonic counters, exposed for tests/report)
+        self.fired: dict[str, int] = {"nan": 0, "preempt": 0,
+                                      "device_error": 0, "torn_checkpoint": 0,
+                                      "serving": 0, "compile": 0}
+        self._serving_ops = 0
+        self._checkpoints = 0
+        # epoch triggers fire once per *crossing*: a fired trigger stays
+        # quiet until the observed boundary epoch goes backwards (a
+        # rollback/resume leg re-entered), then re-arms if budget remains
+        self._armed = {"nan": True, "preempt": True, "device_error": True}
+        self._last_epoch: Optional[int] = None
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_spec(cls, spec: str) -> "Chaos":
+        """Parse a ``key=value,key=value`` spec (the ``TDQ_CHAOS`` env /
+        ``bench.py --chaos`` format), e.g.
+        ``"nan_epoch=60,preempt_epoch=150,serving_fail_rate=0.25,seed=1"``.
+        ``compile_fail_buckets`` takes ``+``-separated sizes
+        (``compile_fail_buckets=256+512``)."""
+        kwargs: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"chaos spec entry {part!r} is not key=value")
+            key, val = (s.strip() for s in part.split("=", 1))
+            if key == "compile_fail_buckets":
+                kwargs[key] = [int(v) for v in val.split("+") if v]
+            elif key == "serving_fail_rate":
+                kwargs[key] = float(val)
+            else:
+                kwargs[key] = int(val)
+        return cls(**kwargs)
+
+    def spec(self) -> str:
+        """The round-trippable spec string (for payloads / run configs)."""
+        parts = []
+        for key, default in (("seed", 0), ("nan_epoch", None),
+                             ("nan_repeats", 1), ("preempt_epoch", None),
+                             ("preempt_repeats", 1),
+                             ("device_error_epoch", None),
+                             ("device_error_repeats", 1),
+                             ("torn_checkpoint_nth", None),
+                             ("serving_fail_n", 0),
+                             ("serving_fail_rate", 0.0)):
+            v = getattr(self, key)
+            if v != default:
+                parts.append(f"{key}={v:g}" if isinstance(v, float)
+                             else f"{key}={v}")
+        if self.compile_fail_buckets:
+            parts.append("compile_fail_buckets="
+                         + "+".join(map(str, self.compile_fail_buckets)))
+        return ",".join(parts)
+
+    # ------------------------------------------------------------------ #
+    def _trip(self, name: str, threshold, epoch: int, repeats: int) -> bool:
+        if threshold is None or epoch < int(threshold):
+            return False
+        if not self._armed[name] or self.fired[name] >= repeats:
+            return False
+        self._armed[name] = False
+        self.fired[name] += 1
+        return True
+
+    def on_train_boundary(self, phase: str, epoch: int, trainables):
+        """Training chunk-boundary hook (called with the ABSOLUTE epoch).
+        May poison the network params (NaN fault), request a graceful
+        preemption, or raise :class:`ChaosDeviceError`; returns the
+        (possibly poisoned) trainables."""
+        # boundary epochs only go backwards when a rollback/resume leg
+        # re-entered training — that's the re-arm point for repeatable
+        # triggers (within one leg they are strictly increasing)
+        if self._last_epoch is not None and epoch <= self._last_epoch:
+            for k in self._armed:
+                self._armed[k] = True
+        self._last_epoch = epoch
+        if self._trip("device_error", self.device_error_epoch, epoch,
+                      self.device_error_repeats):
+            log_event("chaos", f"injected device error at {phase} epoch "
+                      f"{epoch}", level="warning", verbose=False,
+                      fault="device_error", phase=phase, epoch=epoch)
+            raise ChaosDeviceError(
+                f"injected device error at {phase} epoch {epoch}")
+        if self._trip("preempt", self.preempt_epoch, epoch,
+                      self.preempt_repeats):
+            from .preemption import request_preemption
+            log_event("chaos", f"injected preemption request at {phase} "
+                      f"epoch {epoch}", level="warning", verbose=False,
+                      fault="preempt", phase=phase, epoch=epoch)
+            request_preemption(signum=None)
+        if self._trip("nan", self.nan_epoch, epoch, self.nan_repeats):
+            import jax
+            import jax.numpy as jnp
+            log_event("chaos", f"injected NaN params at {phase} epoch "
+                      f"{epoch}", level="warning", verbose=False,
+                      fault="nan", phase=phase, epoch=epoch)
+            trainables = dict(trainables)
+            trainables["params"] = jax.tree_util.tree_map(
+                lambda a: jnp.full_like(a, jnp.nan), trainables["params"])
+        return trainables
+
+    def on_rollback(self, epoch: Optional[int] = None):
+        """Recovery-rollback hook (:class:`~..resilience.ResilientFit`
+        calls this): re-arm the epoch triggers so ``*_repeats`` budgets
+        apply per recovery attempt.  A rollback restores to the very
+        boundary a trigger fired at, so the epoch-regression re-arm above
+        never sees a smaller epoch — the explicit notification does it."""
+        self._last_epoch = None if epoch is None else int(epoch)
+        for k in self._armed:
+            self._armed[k] = True
+
+    # ------------------------------------------------------------------ #
+    def on_checkpoint_saved(self, path: str) -> bool:
+        """Checkpoint post-promote hook: corrupt the Nth save written under
+        this plan (truncate + garble the largest payload file), simulating
+        storage-level corruption of a fully-renamed checkpoint.  Returns
+        whether the tear fired."""
+        if self.torn_checkpoint_nth is None:
+            return False
+        self._checkpoints += 1
+        if self._checkpoints != int(self.torn_checkpoint_nth):
+            return False
+        victim, size = None, -1
+        for root, _, files in os.walk(path):
+            for f in files:
+                if f == "tdq_meta.json":
+                    continue  # the meta (with its checksum) must survive
+                fp = os.path.join(root, f)
+                if os.path.getsize(fp) > size:
+                    victim, size = fp, os.path.getsize(fp)
+        if victim is None:
+            return False
+        with open(victim, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+            fh.seek(0)
+            fh.write(b"\xde\xad")
+        self.fired["torn_checkpoint"] += 1
+        log_event("chaos", f"tore checkpoint payload {victim} "
+                  f"({size} -> {max(size // 2, 1)} bytes)", level="warning",
+                  verbose=False, fault="torn_checkpoint", path=str(path))
+        return True
+
+    def on_serving_op(self):
+        """Serving-op hook (batcher flush / engine call): raises
+        :class:`ChaosServingError` for the first ``serving_fail_n`` ops,
+        then at ``serving_fail_rate`` per the seeded RNG."""
+        if not self.serving_fail_n and not self.serving_fail_rate:
+            return
+        self._serving_ops += 1
+        if self._serving_ops <= self.serving_fail_n \
+                or (self.serving_fail_rate
+                    and self._rng.uniform() < self.serving_fail_rate):
+            self.fired["serving"] += 1
+            raise ChaosServingError(
+                f"injected serving fault (op #{self._serving_ops})")
+
+    def on_bucket_compile(self, kind, bucket: int):
+        """Engine first-touch hook: fail the compile of a targeted bucket
+        (drives per-bucket quarantine)."""
+        if bucket in self.compile_fail_buckets:
+            self.fired["compile"] += 1
+            raise ChaosFault(
+                f"injected compile failure for bucket {bucket} (kind={kind})")
+
+    # ------------------------------------------------------------------ #
+    def __enter__(self) -> "Chaos":
+        _STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            _STACK.remove(self)
+        except ValueError:
+            pass
+        return False
+
+
+_STACK: list = []
+_env_chaos: Optional[Chaos] = None
+_env_checked = False
+
+
+def active_chaos() -> Optional[Chaos]:
+    """The innermost active :class:`Chaos`, the ``TDQ_CHAOS``-configured
+    process plan, or None.  THE hot-path check: with no scope open and no
+    env var this is one truthiness test + one cached-global read."""
+    if _STACK:
+        return _STACK[-1]
+    global _env_chaos, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        spec = os.environ.get(_ENV_VAR, "").strip()
+        if spec and spec.lower() not in ("0", "off", "false", "none"):
+            _env_chaos = Chaos.from_spec(spec)
+            log_event("chaos", f"process-wide chaos active from ${_ENV_VAR}: "
+                      f"{spec}", level="warning", verbose=True, spec=spec)
+    return _env_chaos
+
+
+def _reset_env_cache():
+    """Test helper: re-read ``TDQ_CHAOS`` on the next ``active_chaos``."""
+    global _env_chaos, _env_checked
+    _env_chaos, _env_checked = None, False

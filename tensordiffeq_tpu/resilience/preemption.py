@@ -1,0 +1,219 @@
+"""Preemption-safe training: graceful SIGTERM/SIGINT shutdown + auto-resume.
+
+Preemptible accelerator time (spot TPUs, borrowed pods) delivers SIGTERM
+with a short grace window.  The reference loses everything not manually
+checkpointed; here the signal turns into an orderly exit:
+
+1. :class:`PreemptionHandler` installs signal handlers that only set a
+   flag (signal-safe — no allocation, no I/O in the handler);
+2. the training loops (:func:`..training.fit.fit_adam`,
+   :func:`..training.lbfgs.lbfgs_minimize`) notice the flag at the next
+   chunk boundary, flush a final checkpoint through the existing
+   ``checkpoint_dir`` hook, and raise :class:`Preempted`;
+3. the caller (or :func:`handle_preemption`) closes its run log and exits
+   with :data:`RESUMABLE_EXIT_CODE` (75, ``EX_TEMPFAIL``) — a distinct
+   status a supervisor can branch on to relaunch;
+4. the relaunch calls :func:`auto_resume` with the ORIGINAL total budgets
+   and the checkpoint dir: it restores, subtracts the epochs/iterations
+   already on record, and continues — no caller bookkeeping.
+
+The grace window is explicit: the handler records when the signal landed,
+and the final flush logs how much of ``deadline_s`` it used (a flush that
+overruns the window logs a warning — the operator's cue to cut
+``checkpoint_every`` or the model size, because the NEXT preemption may
+not be so lucky).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from ..telemetry import log_event
+
+#: ``EX_TEMPFAIL``: the exit status of a run that stopped resumable-clean.
+#: Distinct from 0 (done) and 1 (crashed) so supervisors can relaunch.
+RESUMABLE_EXIT_CODE = 75
+
+
+class Preempted(RuntimeError):
+    """Raised by the training loops at the first chunk boundary after a
+    preemption request — AFTER the final checkpoint flush.  Carries
+    ``phase``, ``epoch`` (absolute), and ``flush_s`` (final checkpoint
+    wall, None when no checkpoint hook was configured)."""
+
+    def __init__(self, phase: str, epoch: int,
+                 flush_s: Optional[float] = None):
+        self.phase = phase
+        self.epoch = int(epoch)
+        self.flush_s = flush_s
+        super().__init__(
+            f"preempted at {phase} epoch {epoch}"
+            + (f" (final checkpoint flushed in {flush_s:.2f}s)"
+               if flush_s is not None else " (no checkpoint hook configured)"))
+
+
+# one process-wide request slot: signals are process-wide, and the training
+# loop that happens to be running is whoever must react
+_REQUEST = {"requested": False, "t": None, "signum": None,
+            "deadline_s": None}
+
+
+def preemption_requested() -> bool:
+    """THE hot-path check the training loops run per chunk boundary."""
+    return _REQUEST["requested"]
+
+
+def request_preemption(signum: Optional[int] = None,
+                       deadline_s: Optional[float] = None) -> None:
+    """Flag a preemption (what the signal handler does; also the chaos
+    layer's injection point).  Idempotent — the first request's timestamp
+    is the one the grace-window accounting uses."""
+    if not _REQUEST["requested"]:
+        _REQUEST.update(requested=True, t=time.monotonic(), signum=signum,
+                        deadline_s=deadline_s)
+
+
+def clear_preemption() -> None:
+    _REQUEST.update(requested=False, t=None, signum=None, deadline_s=None)
+
+
+def preemption_grace_used_s() -> Optional[float]:
+    """Seconds since the preemption request, or None when none pending."""
+    return None if _REQUEST["t"] is None else time.monotonic() - _REQUEST["t"]
+
+
+def note_final_flush(phase: str, epoch: int, flush_s: float,
+                     verbose: bool = True) -> None:
+    """Record the final-checkpoint flush against the grace window (called
+    by the training loops right before raising :class:`Preempted`) — and
+    CLEAR the request: it has been serviced.  A process that exits next
+    (the normal path) doesn't care; a process that instead resumes
+    in-process (tests, supervisors) must not have the stale flag re-trip
+    the very first boundary of the resumed leg.  A new signal simply sets
+    the flag again."""
+    used = preemption_grace_used_s()
+    deadline = _REQUEST["deadline_s"]
+    over = (deadline is not None and used is not None and used > deadline)
+    log_event("preempt",
+              f"preemption at {phase} epoch {epoch}: final checkpoint "
+              f"flushed in {flush_s:.2f}s"
+              + (f", {used:.2f}s after the signal" if used is not None else "")
+              + (f" — OVER the {deadline:.0f}s deadline" if over else ""),
+              level="warning" if over else "info", verbose=verbose,
+              phase=phase, epoch=epoch, flush_s=flush_s,
+              grace_used_s=used, deadline_s=deadline, over_deadline=over)
+    clear_preemption()
+
+
+class PreemptionHandler:
+    """Scoped SIGTERM/SIGINT -> graceful-shutdown wiring.
+
+    ::
+
+        with PreemptionHandler(deadline_s=30) as ph:
+            try:
+                ResilientFit(solver, ckpt).fit(tf_iter=100_000)
+            except Preempted:
+                sys.exit(RESUMABLE_EXIT_CODE)
+
+    The handler only sets the request flag; all real work (checkpoint
+    flush, run-log close) happens in normal control flow at the next chunk
+    boundary.  On exit the previous signal dispositions are restored and a
+    still-pending request is cleared.
+    """
+
+    def __init__(self, deadline_s: float = 30.0,
+                 signals=(signal.SIGTERM, signal.SIGINT)):
+        self.deadline_s = float(deadline_s)
+        self.signals = tuple(signals)
+        self._previous: dict = {}
+
+    def _on_signal(self, signum, frame):
+        request_preemption(signum=signum, deadline_s=self.deadline_s)
+
+    @property
+    def requested(self) -> bool:
+        return preemption_requested()
+
+    def __enter__(self) -> "PreemptionHandler":
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        clear_preemption()
+        return False
+
+
+def handle_preemption(exc: Preempted, logger=None,
+                      exit_process: bool = True) -> int:
+    """Standard tail of a preempted run: close the run log (manifest gets
+    its metrics snapshot + end time), log the resumable exit, and — unless
+    ``exit_process=False`` — exit with :data:`RESUMABLE_EXIT_CODE`."""
+    log_event("preempt", f"exiting resumable (status {RESUMABLE_EXIT_CODE}) "
+              f"after {exc}", verbose=True, level="warning",
+              status=RESUMABLE_EXIT_CODE, phase=exc.phase, epoch=exc.epoch)
+    if logger is not None:
+        logger.close()
+    if exit_process:
+        sys.exit(RESUMABLE_EXIT_CODE)
+    return RESUMABLE_EXIT_CODE
+
+
+def auto_resume(solver, checkpoint_dir: str, tf_iter: int = 0,
+                newton_iter: int = 0, checkpoint_every: int = 100,
+                telemetry=None, **fit_kw):
+    """Resume (or start) a fit against TOTAL budgets, fast-forwarding
+    whatever ``checkpoint_dir`` already holds.
+
+    The caller states the run it *wants* — ``tf_iter`` total Adam epochs,
+    ``newton_iter`` total L-BFGS iterations — and this entrypoint does the
+    bookkeeping: if a restorable checkpoint exists it is loaded (epochs
+    trained and ``newton_done`` come back with it) and only the remaining
+    budgets are run; otherwise the fit starts fresh.  Either way the fit
+    checkpoints into the same ``checkpoint_dir`` every
+    ``checkpoint_every`` epochs, so the NEXT preemption resumes too.
+    Returns the solver.
+    """
+    from ..checkpoint import checkpoint_exists
+
+    if checkpoint_exists(checkpoint_dir):
+        solver.restore_checkpoint(checkpoint_dir)
+        done = len(solver.losses)
+        newton_done = int(getattr(solver, "newton_done", 0))
+        log_event("resume", f"auto-resume from {checkpoint_dir}: "
+                  f"{done}/{tf_iter} Adam epochs and {newton_done}/"
+                  f"{newton_iter} L-BFGS iters already on record",
+                  verbose=getattr(solver, "verbose", True),
+                  checkpoint_dir=str(checkpoint_dir), epochs_done=done,
+                  newton_done=newton_done, tf_iter=tf_iter,
+                  newton_iter=newton_iter)
+    else:
+        done, newton_done = 0, 0
+    rem_adam = max(0, int(tf_iter) - done)
+    rem_newton = max(0, int(newton_iter) - newton_done)
+    if rem_adam or rem_newton:
+        solver.fit(tf_iter=rem_adam, newton_iter=rem_newton,
+                   checkpoint_dir=checkpoint_dir,
+                   checkpoint_every=checkpoint_every, telemetry=telemetry,
+                   **fit_kw)
+    return solver
+
+
+def is_resumable_exit(returncode: Optional[int]) -> bool:
+    """Supervisor helper: did a child exit asking to be relaunched?"""
+    return returncode == RESUMABLE_EXIT_CODE
+
+
+def default_checkpoint_dir(run_name: str) -> str:
+    """Conventional per-run checkpoint location (under ``runs/``, or
+    ``TDQ_CKPT_ROOT`` when set) for callers with no opinion."""
+    root = os.environ.get("TDQ_CKPT_ROOT", "runs")
+    return os.path.join(root, f"{run_name}_ckpt")

@@ -1,0 +1,254 @@
+"""Divergence recovery: rollback + remedy ladder around the solver's fit.
+
+Self-adaptive PINN training is a minimax and occasionally loses: a λ
+distribution saturates, a causal stage over-weights a hard bin, and the
+loss goes NaN (Adaptive Self-supervision for PINNs, arXiv:2207.04084,
+documents exactly this failure mode; adaptive collocation resampling —
+PACMANN, arXiv:2411.19632 — adds its own).  PR 4's telemetry sentinel
+turns that into a structured
+:class:`~tensordiffeq_tpu.telemetry.TrainingDiverged` — but raising is
+only a diagnosis.  :class:`ResilientFit` is the treatment:
+
+1. **rollback** — restore the last good checkpoint (an epoch-0 baseline
+   is written on entry, so there is ALWAYS somewhere to roll back to);
+2. **remedy** — apply the next rung of a configurable ladder, mildest
+   first, cumulatively:
+
+   * ``lr_backoff``  — scale both learning rates down (default ×0.5);
+   * ``lambda_reset``— reset SA-λ to their entry values (a saturated λ
+     distribution is trained state; rollback alone restores the λ that
+     were already mid-blow-up);
+   * ``grad_clip``   — train on with global-norm gradient clipping
+     (threaded through the optimizer; Adam moments restart, which is
+     intended — the old moments aimed at the divergence);
+
+3. **retry** — re-run the remaining budget, up to ``max_retries``
+   recoveries per ``fit`` call; exhaustion re-raises the last
+   :class:`TrainingDiverged`.
+
+Every step lands in telemetry (``rollback`` / ``remedy`` events +
+``resilience.*`` counters), so ``telemetry.report`` can narrate what
+failed and what healed.  Preemptions pass through by default (the caller
+exits resumable); ``resume_on_preemption=True`` instead restores and
+continues in-process — the single-process analogue of a supervisor
+relaunch, used by tests and the chaos demo.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from ..telemetry import (TrainingDiverged, TrainingTelemetry,
+                         as_training_telemetry, log_event)
+from ..utils import tree_copy
+from .preemption import Preempted
+
+Remedy = Union[str, tuple, Callable]
+
+
+def _scale_lr(lr, factor: float):
+    """Scale a learning rate that may be a float or an optax-style
+    schedule (callable of the step count)."""
+    if callable(lr):
+        return lambda count, _lr=lr, _f=factor: _lr(count) * _f
+    return float(lr) * factor
+
+
+class ResilientFit:
+    """Supervised training: ``solver.fit`` with automatic
+    checkpoint-rollback and a remedy ladder on divergence.
+
+    Args:
+      solver: a compiled :class:`~tensordiffeq_tpu.CollocationSolverND`.
+      checkpoint_dir: rollback/resume anchor.  The supervisor writes an
+        entry baseline here if the directory holds no checkpoint yet, and
+        threads it through ``fit(checkpoint_dir=)`` so recovery never
+        loses more than ``checkpoint_every`` epochs.
+      checkpoint_every: periodic-checkpoint cadence in epochs (also the
+        maximum rollback loss).
+      max_retries: recoveries allowed per :meth:`fit` call before the
+        divergence is re-raised.
+      remedies: the ladder — a sequence of ``"lr_backoff"`` /
+        ``"lambda_reset"`` / ``"grad_clip"`` names, ``(name, value)``
+        pairs to override the default strength (backoff factor / ignored /
+        clip norm), or callables ``remedy(solver, supervisor)`` for custom
+        rungs.  Applied cumulatively, one rung per recovery; a recovery
+        past the last rung re-applies it (``lr_backoff`` keeps halving).
+      lr_backoff: default backoff factor for ``lr_backoff`` rungs.
+      grad_clip: default global-norm bound for the ``grad_clip`` rung.
+      telemetry: a :class:`TrainingTelemetry` or
+        :class:`~tensordiffeq_tpu.telemetry.RunLogger` threaded into every
+        fit leg.  None builds a sentinel-only subscriber (no JSONL, no
+        grad-norm instrumentation — the compiled step stays bit-identical
+        to an unsupervised run).  ``raise_on_divergence`` is forced on:
+        the supervisor IS the divergence handler.
+      resume_on_preemption: continue in-process after a
+        :class:`Preempted` (restore + re-enter) instead of re-raising.
+    """
+
+    DEFAULT_REMEDIES: tuple = ("lr_backoff", "lambda_reset", "grad_clip")
+
+    def __init__(self, solver, checkpoint_dir: str,
+                 checkpoint_every: int = 100, max_retries: int = 3,
+                 remedies: Optional[Sequence[Remedy]] = None,
+                 lr_backoff: float = 0.5, grad_clip: float = 1.0,
+                 telemetry=None, resume_on_preemption: bool = False):
+        if not getattr(solver, "_compiled", False):
+            raise ValueError("ResilientFit needs a compiled solver "
+                             "(call solver.compile(...) first)")
+        self.solver = solver
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_retries = int(max_retries)
+        self.remedies = tuple(remedies if remedies is not None
+                              else self.DEFAULT_REMEDIES)
+        self.lr_backoff = float(lr_backoff)
+        self.grad_clip_norm = float(grad_clip)
+        self.resume_on_preemption = bool(resume_on_preemption)
+        tele = as_training_telemetry(telemetry)
+        if tele is None:
+            tele = TrainingTelemetry(logger=None, log_every=0,
+                                     grad_norm=False)
+        # the supervisor catches TrainingDiverged — a subscriber configured
+        # not to raise would silently disable every recovery below
+        tele.raise_on_divergence = True
+        self.telemetry = tele
+        self._registry = tele.registry
+        self._grad_clip_active: Optional[float] = None
+        self._rung = 0
+        self.recoveries = 0          # lifetime, across fit() calls
+        self.preemptions_resumed = 0
+        self._lambdas0 = None        # entry SA-λ snapshot (lambda_reset)
+
+    # ------------------------------------------------------------------ #
+    def _event(self, kind: str, message: str, **fields):
+        log_event(kind, message, level="warning",
+                  verbose=getattr(self.solver, "verbose", True),
+                  logger=self.telemetry.logger, **fields)
+
+    def _apply_remedy(self, attempt: int) -> str:
+        """Apply the next ladder rung (cumulative); returns its label."""
+        rung = self.remedies[min(self._rung, len(self.remedies) - 1)] \
+            if self.remedies else "none"
+        self._rung += 1
+        value = None
+        if isinstance(rung, tuple):
+            rung, value = rung
+        if callable(rung):
+            label = getattr(rung, "__name__", "custom")
+            rung(self.solver, self)
+        elif rung == "lr_backoff":
+            factor = self.lr_backoff if value is None else float(value)
+            self.solver.lr = _scale_lr(self.solver.lr, factor)
+            self.solver.lr_weights = _scale_lr(self.solver.lr_weights, factor)
+            label = f"lr_backoff(x{factor:g})"
+        elif rung == "lambda_reset":
+            if self._lambdas0 is not None:
+                self.solver.lambdas = tree_copy(self._lambdas0)
+            label = "lambda_reset"
+        elif rung == "grad_clip":
+            clip = self.grad_clip_norm if value is None else float(value)
+            self._grad_clip_active = clip
+            label = f"grad_clip({clip:g})"
+        elif rung == "none":
+            label = "none"
+        else:
+            raise ValueError(f"unknown remedy {rung!r}; expected "
+                             "'lr_backoff', 'lambda_reset', 'grad_clip', "
+                             "or a callable")
+        self._registry.counter("resilience.remedies", remedy=label).inc()
+        self._event("remedy", f"applied remedy {label} "
+                    f"(recovery {attempt}/{self.max_retries})",
+                    remedy=label, attempt=attempt)
+        return label
+
+    def _rollback(self, exc: TrainingDiverged, attempt: int):
+        bad_epoch = exc.epoch
+        self.solver.restore_checkpoint(self.checkpoint_dir)
+        good_epoch = len(self.solver.losses)
+        from .chaos import active_chaos
+        chaos = active_chaos()
+        if chaos is not None:
+            # repeatable chaos triggers re-arm per recovery attempt
+            chaos.on_rollback(good_epoch)
+        self._registry.counter("resilience.rollbacks").inc()
+        self._event("rollback",
+                    f"divergence at {exc.phase} epoch {bad_epoch}: rolled "
+                    f"back to epoch {good_epoch} (recovery {attempt}/"
+                    f"{self.max_retries})", phase=exc.phase,
+                    diverged_epoch=bad_epoch, restored_epoch=good_epoch,
+                    attempt=attempt)
+
+    # ------------------------------------------------------------------ #
+    def fit(self, tf_iter: int = 0, newton_iter: int = 0, **fit_kw):
+        """Run ``solver.fit`` to the full budget, recovering along the way.
+        Budgets are TOTAL from this call's entry — rollbacks and resumes
+        re-derive the remainder from the epochs actually on record.
+        Returns the solver."""
+        from ..checkpoint import checkpoint_exists
+
+        solver = self.solver
+        self._lambdas0 = tree_copy(solver.lambdas)
+        target_epochs = len(solver.losses) + int(tf_iter)
+        target_newton = int(getattr(solver, "newton_done", 0)) \
+            + int(newton_iter)
+        if not checkpoint_exists(self.checkpoint_dir):
+            # the entry baseline: epoch-0 rollback target.  Without it a
+            # divergence inside the first checkpoint interval has nowhere
+            # to roll back to.
+            solver.save_checkpoint(self.checkpoint_dir)
+        retries = 0
+        last_exc: Optional[TrainingDiverged] = None
+        while True:
+            rem_adam = max(0, target_epochs - len(solver.losses))
+            rem_newton = max(
+                0, target_newton - int(getattr(solver, "newton_done", 0)))
+            if not rem_adam and not rem_newton:
+                break
+            try:
+                solver.fit(tf_iter=rem_adam, newton_iter=rem_newton,
+                           checkpoint_dir=self.checkpoint_dir,
+                           checkpoint_every=self.checkpoint_every,
+                           telemetry=self.telemetry,
+                           grad_clip=self._grad_clip_active, **fit_kw)
+                break
+            except TrainingDiverged as e:
+                retries += 1
+                self.recoveries += 1
+                last_exc = e
+                if retries > self.max_retries:
+                    self._event(
+                        "recovery_exhausted",
+                        f"retry budget exhausted after {self.max_retries} "
+                        f"recoveries; re-raising {e}",
+                        attempt=retries, max_retries=self.max_retries)
+                    raise
+                self._rollback(e, retries)
+                self._apply_remedy(retries)
+            except Preempted as e:
+                if not self.resume_on_preemption:
+                    raise
+                # single-process resume: restore the preemption flush and
+                # carry on (what a supervisor relaunch would do across
+                # processes via preemption.auto_resume)
+                from .preemption import clear_preemption
+                clear_preemption()
+                solver.restore_checkpoint(self.checkpoint_dir)
+                self.preemptions_resumed += 1
+                self._registry.counter("resilience.resumes").inc()
+                self._event(
+                    "resume", f"resumed in-process after {e}: "
+                    f"{len(solver.losses)}/{target_epochs} epochs on record",
+                    phase=e.phase, preempted_epoch=e.epoch,
+                    restored_epoch=len(solver.losses))
+        if retries and last_exc is not None:
+            final = float(np.asarray(
+                solver.losses[-1].get("Total Loss", np.nan))) \
+                if solver.losses else None
+            self._event("recovered",
+                        f"run completed after {retries} recover{'y' if retries == 1 else 'ies'} "
+                        f"(final loss {final})", recoveries=retries,
+                        final_loss=final)
+        return solver

@@ -16,6 +16,7 @@ latency-percentile reporting).
     u, f = engine.predict(X_grid)
 """
 
-from .batcher import PendingQuery, RequestBatcher  # noqa: F401
-from .engine import InferenceEngine  # noqa: F401
+from .batcher import (PendingQuery, RequestBatcher,  # noqa: F401
+                      RequestTimeout)
+from .engine import EngineDegraded, InferenceEngine  # noqa: F401
 from .surrogate import Surrogate  # noqa: F401

@@ -11,6 +11,18 @@ queries into one engine call under the standard serving policy pair:
   long (tail-latency bound; checked by :meth:`poll`, which hosts call from
   their event loop, or implicitly by a blocking :meth:`result`).
 
+Failure handling (:mod:`tensordiffeq_tpu.resilience`): a flush whose op
+raises retries under an optional
+:class:`~tensordiffeq_tpu.resilience.RetryPolicy` (transient device faults
+heal invisibly — waiters just see a slower batch) before failing every
+coalesced waiter; an optional
+:class:`~tensordiffeq_tpu.resilience.CircuitBreaker` fast-fails NEW
+submissions while the backend is down instead of stacking them behind a
+corpse; and every request carries a deadline (``request_timeout_s``) — a
+waiter whose batch never executes (breaker stuck open, dead worker) raises
+a structured :class:`RequestTimeout` and is counted ``timed_out``, never
+blocks forever.
+
 Per-request latency (submit -> result ready) and throughput are recorded and
 summarised through :func:`tensordiffeq_tpu.profiling.percentiles` /
 :func:`~tensordiffeq_tpu.profiling.stopwatch`, so a ``--serving`` benchmark
@@ -26,19 +38,33 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..profiling import percentiles, stopwatch
-from ..telemetry import default_registry
+from ..resilience.breaker import CircuitOpenError
+from ..resilience.chaos import active_chaos
+from ..telemetry import default_registry, log_event
+
+
+class RequestTimeout(RuntimeError):
+    """A request's deadline expired before its batch executed.  Carries
+    ``waited_s`` — how long the request sat in the queue."""
+
+    def __init__(self, waited_s: float):
+        self.waited_s = float(waited_s)
+        super().__init__(
+            f"request timed out after {waited_s:.3f}s without its batch "
+            "executing (backend down or circuit breaker open)")
 
 
 class PendingQuery:
     """Handle returned by :meth:`RequestBatcher.submit`."""
 
-    __slots__ = ("_batcher", "_value", "_error", "_done")
+    __slots__ = ("_batcher", "_value", "_error", "_done", "_t_submit")
 
-    def __init__(self, batcher):
+    def __init__(self, batcher, t_submit: float):
         self._batcher = batcher
         self._value = None
         self._error = None
         self._done = False
+        self._t_submit = t_submit
 
     @property
     def done(self) -> bool:
@@ -49,9 +75,23 @@ class PendingQuery:
         not flushed yet, forces a flush (a caller blocking on a result is
         the latency deadline in person).  A batch whose op raised delivers
         that exception to EVERY waiter, not just whoever triggered the
-        flush."""
-        if not self._done:
-            self._batcher.flush()
+        flush.  When the batch CANNOT execute (circuit breaker open), the
+        call waits — bounded by the batcher's ``request_timeout_s`` — and
+        raises :class:`RequestTimeout` once this request's deadline
+        expires: no caller ever blocks forever on a dead worker."""
+        while not self._done:
+            try:
+                self._batcher.flush()
+            except Exception:
+                # flush() re-raises to its caller AFTER delivering the
+                # failure to every handle — ours included; fall through to
+                # raise our own copy below
+                pass
+            if self._done:
+                break
+            # flush could not run the batch (breaker open): wait out the
+            # cool-down in small ticks, bounded by this request's deadline
+            self._batcher._wait_or_expire(self)
         if self._error is not None:
             raise self._error
         return self._value
@@ -78,20 +118,39 @@ class RequestBatcher:
         multi-equation residuals).
       max_batch: flush when this many points are pending.
       max_latency_s: flush when the oldest pending request is this old.
+      retry: optional :class:`~tensordiffeq_tpu.resilience.RetryPolicy` —
+        a failed op is retried on the SAME coalesced batch (backoff +
+        deterministic jitter) before the failure reaches any waiter.
+      breaker: optional
+        :class:`~tensordiffeq_tpu.resilience.CircuitBreaker` — records
+        every op outcome; while open, :meth:`submit` fast-fails new
+        requests with :class:`CircuitOpenError` and queued requests wait
+        (bounded by their deadline) for the half-open probe.
+      request_timeout_s: per-request deadline.  A request still pending
+        this long after submit — its batch never executed — fails with
+        :class:`RequestTimeout` and counts ``timed_out``.  ``None``
+        disables (then a dead backend with no breaker can block a
+        ``result()`` caller indefinitely — serve with a deadline).
       clock: time source (injectable for tests); defaults to
         ``time.monotonic``.
+      sleep: blocking-wait primitive used by :meth:`PendingQuery.result`
+        while the breaker is open (injectable for tests).
       registry: :class:`~tensordiffeq_tpu.telemetry.MetricsRegistry`
         receiving the batcher's health metrics — live queue depth
         (``serving.batcher.queue_depth`` gauge), request/batch/point/
-        failure counters, the coalesced-batch-size histogram and the
-        per-request latency histogram (``serving.batcher.latency_s``).
-        Defaults to the process-wide shared registry; :meth:`stats` keeps
-        its original dict contract independently.
+        failure/retry/timeout counters, the coalesced-batch-size histogram
+        and the per-request latency histogram
+        (``serving.batcher.latency_s``).  Defaults to the process-wide
+        shared registry; :meth:`stats` keeps its original dict contract
+        independently.
     """
 
     def __init__(self, engine=None, op: Optional[Callable] = None,
                  max_batch: int = 4096, max_latency_s: float = 0.01,
+                 retry=None, breaker=None,
+                 request_timeout_s: Optional[float] = 30.0,
                  clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
                  registry=None):
         if op is None:
             if engine is None:
@@ -100,7 +159,12 @@ class RequestBatcher:
         self._op = op
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_s)
+        self.retry = retry
+        self.breaker = breaker
+        self.request_timeout_s = (None if request_timeout_s is None
+                                  else float(request_timeout_s))
         self._clock = clock
+        self._sleep = sleep
         self._pending: list = []   # (X, handle, t_submit)
         self._pending_pts = 0
         self._first_submit: Optional[float] = None
@@ -110,6 +174,9 @@ class RequestBatcher:
         self._n_batches = 0
         self._n_points = 0
         self._n_failed = 0
+        self._n_timed_out = 0
+        self._n_rejected = 0
+        self._n_retried_ok = 0
         self._last_flush: Optional[float] = None
         self._metrics = registry if registry is not None else default_registry()
 
@@ -121,15 +188,25 @@ class RequestBatcher:
     def submit(self, X) -> PendingQuery:
         """Queue a ``[n, ndim]`` (or single-point ``[ndim]``) query; returns
         a :class:`PendingQuery`.  Flushes inline when the pending point
-        count reaches ``max_batch``."""
+        count reaches ``max_batch``.  While the circuit breaker is open the
+        handle comes back already failed with
+        :class:`~tensordiffeq_tpu.resilience.CircuitOpenError` — fast
+        structured rejection instead of queue pileup."""
         X = np.atleast_2d(np.asarray(X, np.float32))
-        handle = PendingQuery(self)
         now = self._clock()
+        handle = PendingQuery(self, now)
+        self._n_requests += 1
+        if self.breaker is not None and self.breaker.state == "open" \
+                and self.breaker.retry_after_s() > 0.0:
+            self._n_rejected += 1
+            self._metrics.counter("serving.batcher.rejected").inc()
+            handle._fail(CircuitOpenError(self.breaker.name,
+                                          self.breaker.retry_after_s()))
+            return handle
         if self._first_submit is None:
             self._first_submit = now
         self._pending.append((X, handle, now))
         self._pending_pts += X.shape[0]
-        self._n_requests += 1
         self._metrics.gauge("serving.batcher.queue_depth").set(
             self._pending_pts)
         if self._pending_pts >= self.max_batch:
@@ -138,18 +215,127 @@ class RequestBatcher:
 
     def poll(self) -> bool:
         """Flush iff the oldest pending request has exceeded the latency
-        deadline.  Returns whether a flush happened."""
+        deadline (also sweeps out requests past their hard
+        ``request_timeout_s``).  Returns whether a flush happened."""
+        self._expire_overdue()
         if self._pending and \
                 self._clock() - self._pending[0][2] >= self.max_latency_s:
             self.flush()
             return True
         return False
 
+    # ------------------------------------------------------------------ #
+    def _expire_overdue(self) -> int:
+        """Fail every pending request past its hard deadline with a
+        structured :class:`RequestTimeout`.  Only reachable in practice
+        while the batch cannot execute (breaker open / callers not
+        flushing): a live backend flushes at ``max_latency_s``, orders of
+        magnitude sooner."""
+        if self.request_timeout_s is None or not self._pending:
+            return 0
+        now = self._clock()
+        keep, expired = [], []
+        for item in self._pending:
+            (expired if now - item[2] >= self.request_timeout_s
+             else keep).append(item)
+        if expired:
+            self._pending = keep
+            self._pending_pts = sum(x.shape[0] for x, _, _ in keep)
+            self._metrics.gauge("serving.batcher.queue_depth").set(
+                self._pending_pts)
+            for x, handle, t in expired:
+                handle._fail(RequestTimeout(now - t))
+            self._n_timed_out += len(expired)
+            self._metrics.counter("serving.batcher.timed_out").inc(
+                len(expired))
+            log_event("serving", f"{len(expired)} coalesced request(s) "
+                      "timed out waiting for a batch that never executed",
+                      level="warning", verbose=False, timed_out=len(expired))
+        return len(expired)
+
+    def _wait_or_expire(self, handle: PendingQuery) -> None:
+        """One blocking-wait tick for :meth:`PendingQuery.result` while the
+        breaker is open: expire the handle if its deadline passed,
+        otherwise sleep until the breaker's cool-down or the deadline,
+        whichever is sooner."""
+        self._expire_overdue()
+        if handle._done:
+            return
+        waits = [0.05]
+        if self.breaker is not None:
+            waits.append(max(self.breaker.retry_after_s(), 0.001))
+        if self.request_timeout_s is not None:
+            remaining = (handle._t_submit + self.request_timeout_s
+                         - self._clock())
+            if remaining <= 0.0:
+                # deadline passed between the expiry sweep and now
+                self._pending = [it for it in self._pending
+                                 if it[1] is not handle]
+                self._pending_pts = sum(x.shape[0]
+                                        for x, _, _ in self._pending)
+                self._n_timed_out += 1
+                self._metrics.counter("serving.batcher.timed_out").inc()
+                handle._fail(RequestTimeout(
+                    self._clock() - handle._t_submit))
+                return
+            waits.append(remaining)
+        self._sleep(max(min(waits), 0.001))
+
+    def _run_op(self, X):
+        """One op execution with chaos injection, retry policy, and
+        breaker accounting."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                chaos = active_chaos()
+                if chaos is not None:
+                    chaos.on_serving_op()
+                out = self._op(X)
+            except Exception as e:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                retriable = (self.retry is not None
+                             and attempt < self.retry.max_attempts
+                             and self.retry.retryable(e)
+                             and (self.breaker is None
+                                  or self.breaker.allow()))
+                if not retriable:
+                    if self.retry is not None:
+                        self._metrics.counter(
+                            "serving.batcher.retry_exhausted").inc()
+                    raise
+                delay = self.retry.delay_s(attempt)
+                self._metrics.counter("serving.batcher.retries").inc()
+                log_event("retry", f"serving op attempt {attempt}/"
+                          f"{self.retry.max_attempts} failed "
+                          f"({type(e).__name__}: {e}); retrying in "
+                          f"{delay:.3f}s", level="warning", verbose=False,
+                          op="batcher", attempt=attempt, delay_s=delay,
+                          error=f"{type(e).__name__}: {e}")
+                self._sleep(delay)
+                continue
+            if self.breaker is not None:
+                self.breaker.record_success()
+            if attempt > 1:
+                self._n_retried_ok += 1
+                self._metrics.counter("serving.batcher.retried_ok").inc()
+            return out
+
     def flush(self) -> int:
         """Evaluate every pending query as one merged device batch and
         deliver results to the handles.  Returns the number of requests
-        served."""
+        served.  While the circuit breaker is open (cool-down not yet
+        elapsed) the batch is NOT executed: pending requests stay queued
+        for the half-open probe, minus any past their hard deadline."""
         if not self._pending:
+            # ordering matters: an empty flush must not consult the breaker
+            # — allow() on a cooled-down open circuit consumes the single
+            # half-open probe slot, and with no op outcome to record the
+            # breaker would wedge half-open forever
+            return 0
+        if self.breaker is not None and not self.breaker.allow():
+            self._expire_overdue()
             return 0
         batch, self._pending = self._pending, []
         self._pending_pts = 0
@@ -158,7 +344,7 @@ class RequestBatcher:
             else batch[0][0]
         try:
             with stopwatch(verbose=False) as sw:
-                out = self._op(X)
+                out = self._run_op(X)
         except Exception as e:
             # the queue is already cleared: deliver the failure to every
             # coalesced waiter (their result() re-raises it) instead of
@@ -194,15 +380,20 @@ class RequestBatcher:
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
         """Serving metrics over everything flushed so far: request/batch/
-        point counts, QPS over the observed span, mean device-batch wall,
-        and per-request latency percentiles (seconds)."""
+        point counts, failure/timeout/rejection/retry tallies, QPS over the
+        observed span, mean device-batch wall, and per-request latency
+        percentiles (seconds)."""
         span = None
         if self._last_flush is not None and self._first_submit is not None:
             span = self._last_flush - self._first_submit
-        served = self._n_requests - len(self._pending) - self._n_failed
+        served = (self._n_requests - len(self._pending) - self._n_failed
+                  - self._n_timed_out - self._n_rejected)
         return {
             "requests": served,
             "failed": self._n_failed,
+            "timed_out": self._n_timed_out,
+            "rejected": self._n_rejected,
+            "retried_ok": self._n_retried_ok,
             "batches": self._n_batches,
             "points": self._n_points,
             "qps": None if not span else served / span,

@@ -40,12 +40,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.derivatives import d, make_ufn, vmap_residual
+from ..resilience.chaos import active_chaos
 from ..telemetry import default_registry, log_event
 from .surrogate import Surrogate
 
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
+
+
+class EngineDegraded(RuntimeError):
+    """Every bucket a query could route to is quarantined for this query
+    kind — the engine cannot serve it (other kinds keep serving)."""
+
+    def __init__(self, kind, buckets):
+        self.kind = kind
+        self.buckets = tuple(buckets)
+        super().__init__(
+            f"all usable buckets {self.buckets} are quarantined for query "
+            f"kind {kind!r} (compile failures); engine degraded for this "
+            "kind")
 
 
 class InferenceEngine:
@@ -97,6 +111,7 @@ class InferenceEngine:
             self._sharding = data_sharding(mesh, ndim=2)
         self._jitted: dict = {}      # kind -> jitted callable(params, X)
         self._cache_keys: set = set()  # (kind, bucket) shapes ever compiled
+        self._quarantined: set = set()  # (kind, bucket) that failed compile
         self._metrics = registry if registry is not None else default_registry()
 
     # ------------------------------------------------------------------ #
@@ -115,8 +130,20 @@ class InferenceEngine:
         return len(self._cache_keys)
 
     def bucket_for(self, n: int) -> int:
-        """The (deterministic) bucket a chunk of ``n`` rows pads to."""
+        """The (deterministic) bucket a chunk of ``n`` rows pads to — the
+        healthy-engine mapping; quarantined rungs reroute upward (see
+        :meth:`quarantined_buckets`)."""
         return min(max(_next_pow2(n), self._buckets[0]), self._buckets[-1])
+
+    def quarantined_buckets(self) -> dict:
+        """``{kind_label: [bucket, ...]}`` of ladder rungs quarantined by
+        compile failures (queries reroute to the next larger healthy rung;
+        empty when the engine is healthy)."""
+        out: dict = {}
+        for kind, bucket in sorted(self._quarantined, key=lambda kb: kb[1]):
+            klabel = kind if isinstance(kind, str) else ":".join(map(str, kind))
+            out.setdefault(klabel, []).append(bucket)
+        return out
 
     # ------------------------------------------------------------------ #
     def _jit_for(self, kind, make_fn: Callable) -> Callable:
@@ -127,20 +154,62 @@ class InferenceEngine:
             self._jitted[kind] = fn
         return fn
 
+    def _bucket_for_routing(self, kind, n: int) -> int:
+        """The bucket a chunk actually routes to: the deterministic
+        :meth:`bucket_for` rung, or the next larger healthy rung when that
+        one is quarantined.  Raises :class:`EngineDegraded` when no usable
+        rung remains for this kind."""
+        base = self.bucket_for(n)
+        for cand in self._buckets:
+            if cand >= base and (kind, cand) not in self._quarantined:
+                return cand
+        raise EngineDegraded(kind, [b for b in self._buckets if b >= base])
+
+    def _quarantine(self, kind, bucket: int, exc: Exception):
+        """First-touch failure of a (kind, bucket) program: quarantine THE
+        BUCKET, not the engine — later queries reroute to the next rung
+        (more padding, same math), and every other kind keeps serving."""
+        self._quarantined.add((kind, bucket))
+        klabel = kind if isinstance(kind, str) else ":".join(map(str, kind))
+        self._metrics.counter("serving.engine.quarantined",
+                              kind=klabel, bucket=bucket).inc()
+        log_event("serving",
+                  f"quarantined kind={klabel} bucket={bucket} after a "
+                  f"first-touch failure ({type(exc).__name__}: {exc}); "
+                  "rerouting to the next bucket", level="warning",
+                  verbose=False, kind_label=klabel, bucket=bucket,
+                  error=f"{type(exc).__name__}: {exc}")
+
     def _run(self, kind, make_fn: Callable, X: np.ndarray):
-        """Pad one ``<= max_bucket`` chunk to its bucket, run, trim."""
+        """Pad one ``<= max_bucket`` chunk to its bucket, run, trim.  A
+        first-touch (compile-time) failure quarantines that (kind, bucket)
+        rung and retries on the next larger one; a failure on an
+        already-proven rung is a runtime fault and propagates (the
+        batcher's retry/breaker layer owns transient runtime faults)."""
         n = X.shape[0]
-        bucket = self.bucket_for(n)
-        if n < bucket:
-            X = np.concatenate(
+        while True:
+            bucket = self._bucket_for_routing(kind, n)
+            Xp = X if n == bucket else np.concatenate(
                 [X, np.zeros((bucket - n, X.shape[1]), X.dtype)])
-        # shard straight from host — jnp.asarray first would commit the
-        # whole batch to device 0 and pay the transfer twice
-        Xd = (jnp.asarray(X) if self._sharding is None
-              else jax.device_put(X, self._sharding))
-        out = self._jit_for(kind, make_fn)(self.surrogate.params, Xd)
-        key = (kind, bucket)
-        if key not in self._cache_keys:
+            # shard straight from host — jnp.asarray first would commit the
+            # whole batch to device 0 and pay the transfer twice
+            Xd = (jnp.asarray(Xp) if self._sharding is None
+                  else jax.device_put(Xp, self._sharding))
+            key = (kind, bucket)
+            first_touch = key not in self._cache_keys
+            try:
+                if first_touch:
+                    chaos = active_chaos()
+                    if chaos is not None:
+                        chaos.on_bucket_compile(kind, bucket)
+                out = self._jit_for(kind, make_fn)(self.surrogate.params, Xd)
+            except Exception as e:
+                if not first_touch:
+                    raise
+                self._quarantine(kind, bucket, e)
+                continue
+            break
+        if first_touch:
             # first touch of this ladder rung: a real XLA compile happened
             self._cache_keys.add(key)
             klabel = kind if isinstance(kind, str) \

@@ -114,6 +114,16 @@ def summarize(run_dir: str) -> dict:
         "checkpoints": len(of_kind("checkpoint")),
         "fit_end": fit_end[-1] if fit_end else None,
         "memory_peak_bytes": mem_peak,
+        # resilience trail (PR 5): what failed and what healed
+        "chaos": of_kind("chaos"),
+        "rollbacks": of_kind("rollback"),
+        "remedies": of_kind("remedy"),
+        "recovered": of_kind("recovered"),
+        "preemptions": of_kind("preempt"),
+        "resumes": of_kind("resume"),
+        "retries": of_kind("retry"),
+        "breaker_transitions": [e for e in of_kind("breaker")
+                                if e.get("to_state")],
     }
 
 
@@ -164,6 +174,7 @@ def report(run_dir: str, width: int = 72) -> str:
                 f"{k}={_fmt(v)}" for k, v in comps.items()))
 
     # -- divergence ----------------------------------------------------- #
+    recovered = bool(s["rollbacks"]) or bool(s["recovered"])
     if s["divergences"]:
         d0 = s["divergences"][0]
         comps0 = d0.get("components") or {}
@@ -173,12 +184,51 @@ def report(run_dir: str, width: int = 72) -> str:
             or v in NONFINITE_TOKENS) or "non-finite components"
         lines.append(f"DIVERGED at {d0.get('phase')} epoch "
                      f"{d0.get('epoch')}: {bad}")
-        lines.append("  -> history after this point is untrustworthy; "
-                     "lower lr / check init_weights / enable remat "
-                     "before rerunning")
+        if not recovered:
+            lines.append("  -> history after this point is untrustworthy; "
+                         "lower lr / check init_weights / enable remat "
+                         "before rerunning — or supervise with "
+                         "resilience.ResilientFit")
     else:
         lines.append("no divergence detected (NaN/Inf sentinel never "
                      "tripped)")
+
+    # -- resilience trail: what failed and what healed ------------------ #
+    if s["chaos"]:
+        kinds = {}
+        for e in s["chaos"]:
+            kinds[e.get("fault", "?")] = kinds.get(e.get("fault", "?"), 0) + 1
+        lines.append("CHAOS ACTIVE (injected faults): " + ", ".join(
+            f"{k} x{n}" for k, n in sorted(kinds.items())))
+    for rb in s["rollbacks"]:
+        lines.append(
+            f"RECOVERY: rolled back {_fmt(rb.get('phase'))} epoch "
+            f"{_fmt(rb.get('diverged_epoch'))} -> "
+            f"{_fmt(rb.get('restored_epoch'))} "
+            f"(attempt {_fmt(rb.get('attempt'))})")
+    for rm in s["remedies"]:
+        lines.append(f"  remedy applied: {rm.get('remedy')}")
+    for rc in s["recovered"]:
+        lines.append(f"HEALED: run completed after "
+                     f"{_fmt(rc.get('recoveries'))} recover(ies), final "
+                     f"loss {_fmt(rc.get('final_loss'))}")
+    for pe in s["preemptions"]:
+        if pe.get("flush_s") is not None:
+            lines.append(
+                f"PREEMPTED at {_fmt(pe.get('phase'))} epoch "
+                f"{_fmt(pe.get('epoch'))}: final checkpoint in "
+                f"{_fmt(pe.get('flush_s'))}s"
+                + (" — OVER DEADLINE" if pe.get("over_deadline") else ""))
+    for rs in s["resumes"]:
+        lines.append(f"RESUMED: {rs.get('message', 'resume')}")
+    if s["retries"]:
+        rec = sum(1 for e in s["retries"] if e.get("recovered"))
+        lines.append(f"serving retries: {len(s['retries'])} events"
+                     + (f", {rec} recovered" if rec else ""))
+    for bt in s["breaker_transitions"]:
+        lines.append(f"breaker {_fmt(bt.get('name'))}: "
+                     f"{bt.get('from_state')} -> {bt.get('to_state')} "
+                     f"({_fmt(bt.get('reason'))})")
 
     # -- λ health ------------------------------------------------------- #
     if s["lambda_last"] is not None:

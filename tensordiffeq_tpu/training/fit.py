@@ -35,6 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..resilience.chaos import active_chaos
+from ..resilience.preemption import (Preempted, note_final_flush,
+                                     preemption_requested)
 from ..telemetry import log_event
 from ..utils import tree_copy
 from .progress import progress_bar
@@ -42,13 +45,21 @@ from .progress import progress_bar
 
 def make_optimizer(lr: "float | Callable" = 0.005,
                    lr_weights: "float | Callable" = 0.005,
-                   b1: float = 0.99, freeze_lambdas: bool = False
+                   b1: float = 0.99, freeze_lambdas: bool = False,
+                   grad_clip: Optional[float] = None
                    ) -> optax.GradientTransformation:
     """Adam for the network + Adam-ascent for λ (reference defaults
     ``lr=0.005, beta_1=0.99``, ``models.py:49-50``), as one transform.
 
     ``freeze_lambdas=True`` pins λ inside the scan (used by NTK weighting,
-    where λ are recomputed analytically between chunks, not trained)."""
+    where λ are recomputed analytically between chunks, not trained).
+
+    ``grad_clip``: global-norm gradient clipping bound applied ahead of
+    both transforms (the divergence-recovery remedy rung — see
+    :class:`~tensordiffeq_tpu.resilience.ResilientFit`).  Note it changes
+    the optimizer-state pytree, so a checkpoint saved without clipping
+    resumes with fresh Adam moments when clipping is turned on (intended:
+    the old moments were aimed at the divergence)."""
 
     def label_fn(trainables):
         return {
@@ -58,8 +69,14 @@ def make_optimizer(lr: "float | Callable" = 0.005,
 
     lam_tx = (optax.set_to_zero() if freeze_lambdas
               else optax.chain(optax.scale(-1.0), optax.adam(lr_weights, b1=b1)))
-    return optax.multi_transform({"net": optax.adam(lr, b1=b1), "lam": lam_tx},
-                                 label_fn)
+    net_tx = optax.adam(lr, b1=b1)
+    if grad_clip is not None:
+        clip = optax.clip_by_global_norm(float(grad_clip))
+        net_tx = optax.chain(clip, net_tx)
+        if not freeze_lambdas:
+            lam_tx = optax.chain(optax.clip_by_global_norm(float(grad_clip)),
+                                 lam_tx)
+    return optax.multi_transform({"net": net_tx, "lam": lam_tx}, label_fn)
 
 
 def opt_state_matches(opt, trainables, opt_state) -> bool:
@@ -298,6 +315,8 @@ def fit_adam(loss_fn: Callable,
              state_hook_every: int = 0,
              stop_fn: Optional[Callable] = None,
              telemetry: Optional[Any] = None,
+             grad_clip: Optional[float] = None,
+             epoch0: int = 0,
              ) -> tuple[Any, Any, FitResult]:
     """Run the Adam(+SA) phase.  Returns ``(trainables, result)`` with
     ``trainables = {"params":…, "lambdas":…}`` at the final step and the
@@ -341,13 +360,27 @@ def fit_adam(loss_fn: Callable,
     per-epoch loss rows, the SA-λ distribution summaries, the
     dispatch/device/data step-time split (``block_until_ready``-fenced),
     and runs the NaN/Inf sentinel — which may raise
-    :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`."""
+    :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`.
+
+    ``grad_clip``: global-norm gradient clipping inside the optimizer
+    (see :func:`make_optimizer`) — the divergence-recovery remedy rung.
+
+    ``epoch0``: absolute epoch of this call's first step, used ONLY by the
+    resilience layer (chaos epoch triggers and preemption events are keyed
+    to absolute run epochs, so they stay meaningful across rollback/resume
+    legs); the loop's own bookkeeping stays call-relative.  Chunk
+    boundaries also run the chaos hooks (when a
+    :class:`~tensordiffeq_tpu.resilience.Chaos` plan is active) and the
+    preemption check: a pending request flushes a final checkpoint through
+    ``state_hook`` and raises
+    :class:`~tensordiffeq_tpu.resilience.Preempted`."""
     result = result or FitResult()
     N_f = X_f.shape[0]
     X_batched, idx_batched, n_batches = make_batches(
         X_f, batch_sz, mesh=mesh, verbose=verbose)
 
-    opt = make_optimizer(lr, lr_weights, freeze_lambdas=freeze_lambdas)
+    opt = make_optimizer(lr, lr_weights, freeze_lambdas=freeze_lambdas,
+                         grad_clip=grad_clip)
     # copy: the chunk runner donates its carried state, and the caller's
     # arrays (solver.params / restored opt_state) must stay valid
     trainables = tree_copy({"params": params, "lambdas": lambdas})
@@ -445,6 +478,33 @@ def fit_adam(loss_fn: Callable,
             pbar.set_postfix(loss=result.losses[-1]["Total Loss"])
         if stop_fn is not None and stop_fn(result):
             break
+        if steps_done < total_steps:
+            # resilience boundary: chaos fault injection (no-op without an
+            # active plan), then the preemption check — a pending request
+            # flushes the final checkpoint through state_hook and raises
+            chaos = active_chaos()
+            if chaos is not None:
+                try:
+                    trainables = chaos.on_train_boundary(
+                        "adam", epoch0 + cur_epochs, trainables)
+                except Exception:
+                    if pbar is not None:
+                        pbar.close()
+                    raise
+            if preemption_requested():
+                t_flush = time.perf_counter()
+                if state_hook is not None:
+                    state_hook(trainables, opt_state, cur_epochs,
+                               best=(best[0], best[1],
+                                     int(best[2]) // max(n_batches, 1)))
+                flush_s = time.perf_counter() - t_flush
+                note_final_flush("adam", epoch0 + cur_epochs, flush_s,
+                                 verbose=verbose)
+                if pbar is not None:
+                    pbar.close()
+                raise Preempted("adam", epoch0 + cur_epochs,
+                                flush_s=(flush_s if state_hook is not None
+                                         else None))
     if pbar is not None:
         pbar.close()
     jax.block_until_ready(trainables)

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..resilience.preemption import (Preempted, note_final_flush,
+                                     preemption_requested)
 from ..telemetry import log_event
 from ..utils import tree_copy
 from .progress import progress_bar
@@ -61,7 +63,8 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    learning_rate: float = 0.8,
                    callback: Optional[Callable] = None,
                    callback_every: int = 0, args: tuple = (),
-                   telemetry=None):
+                   telemetry=None, iter0: int = 0,
+                   preempt_flush: Optional[Callable] = None):
     """Minimise ``fun(pytree, *args) -> scalar`` with jitted L-BFGS.
 
     Returns ``(x_final, x_best, f_best, best_iter, history)`` where
@@ -81,6 +84,14 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     :class:`~tensordiffeq_tpu.telemetry.TrainingTelemetry` — records the
     per-chunk dispatch/device step-time split (``block_until_ready``
     fenced), same contract as the Adam loop's.
+
+    ``iter0`` / ``preempt_flush``: the preemption contract (mirrors the
+    Adam loop's ``epoch0``/``state_hook``): a pending preemption request is
+    noticed at the next chunk boundary, ``preempt_flush(done, x, best)``
+    writes the final checkpoint UNCONDITIONALLY (the cadence-gated
+    ``callback`` may have skipped this boundary), and
+    :class:`~tensordiffeq_tpu.resilience.Preempted` is raised with the
+    absolute iteration ``iter0 + done``.
     """
     if eager:
         opt = optax.lbfgs(learning_rate=learning_rate,
@@ -169,6 +180,18 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             # the live running best rides along so mid-run checkpoints can
             # carry the best iterate (not just the latest one)
             callback(done, x, best)
+        if preemption_requested() and done < maxiter:
+            t_flush = time.perf_counter()
+            if preempt_flush is not None:
+                preempt_flush(done, x, best)
+            flush_s = time.perf_counter() - t_flush
+            note_final_flush("l-bfgs", iter0 + done, flush_s,
+                             verbose=verbose)
+            if pbar is not None:
+                pbar.close()
+            raise Preempted("l-bfgs", iter0 + done,
+                            flush_s=(flush_s if preempt_flush is not None
+                                     else None))
         if pbar is not None:
             pbar.update(n)
             pbar.set_postfix(loss=float(values[-1]))
@@ -198,7 +221,8 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
               maxiter: int = 1000, memory_size: int = 50,
               verbose: bool = True, chunk: int = 100, eager: bool = False,
               callback: Optional[Callable] = None,
-              callback_every: int = 0, telemetry=None):
+              callback_every: int = 0, telemetry=None, iter0: int = 0,
+              preempt_flush: Optional[Callable] = None):
     """L-BFGS phase over network params with SA λ frozen
     (reference ``fit.py:60-89``).
 
@@ -218,7 +242,8 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
         fun, params, maxiter=maxiter, memory_size=memory_size,
         chunk=chunk, verbose=verbose, eager=eager,
         callback=callback, callback_every=callback_every,
-        args=(lam_bcs, lam_res, X_f, lam_data), telemetry=telemetry)
+        args=(lam_bcs, lam_res, X_f, lam_data), telemetry=telemetry,
+        iter0=iter0, preempt_flush=preempt_flush)
     log_event("l-bfgs",
               f"{len(history)} iters in {time.time() - t0:.1f}s, "
               f"best loss {float(f_best):.3e} @ iter {int(i_best)}",

@@ -45,6 +45,21 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     Called automatically by ``CollocationSolverND.compile`` /
     ``DiscoveryModel.compile``; safe to call repeatedly or before backend
     init.  Returns the cache dir in use, or ``None`` when disabled.
+
+    **CPU backend: the cache stays OFF unless explicitly requested**
+    (``path`` arg or ``TDQ_COMPILE_CACHE=<dir>``).  Two measured reasons
+    (PR 5).  Correctness: with the shared default dir, a cold-cache
+    ``pytest tests/test_checkpoint.py`` failed its sharded-resume
+    trajectory check (max rel diff 0.49 after 20 toy SA steps) while the
+    same run passed with the cache off or warm — cache-served executables
+    can differ from fresh compiles at a level the minimax amplifies, and
+    WHICH programs get cached depends on the 0.5 s compile-time threshold,
+    i.e. on machine load.  Concurrency: tier-1 and a CPU-fallback bench
+    sharing ``/tmp/tdq_xla_cache_*`` were observed garbaging each other's
+    numerics (PR-4 note: 0.0 min_loss / 1.6 rel-L2).  CPU compiles here
+    cost seconds, so the cache bought little on that backend anyway; TPU
+    (where a tunnel-window compile costs minutes and processes are
+    serialized by the tunnel) keeps the shared cache.
     """
     global _compile_cache_dir, _compile_cache_wired
     env = os.environ.get("TDQ_COMPILE_CACHE", "")
@@ -57,6 +72,13 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
         if already:  # ... nor a user-configured jax cache dir
             _compile_cache_dir, _compile_cache_wired = already, True
             return already
+        if not env:
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = None
+            if backend == "cpu":
+                return None  # see docstring: correctness over warm starts
         uid = getattr(os, "getuid", lambda: "")()
         path = env or os.path.join(tempfile.gettempdir(),
                                    f"tdq_xla_cache_{uid}")

@@ -67,3 +67,15 @@ def test_schrodinger_example_runs():
 def test_ac_sa_periodic_net_example_runs():
     """AC-SA with the exactly-periodic embedding ansatz (--periodic-net)."""
     run_example("ac_sa.py", "--periodic-net")
+
+
+@pytest.mark.slow
+def test_ac_resilient_example_runs():
+    """The PR-5 acceptance demo: ONE supervised run survives a chaos NaN
+    divergence and a chaos preemption, the serving leg heals injected
+    faults with zero hung waiters, and the run log holds the full trail
+    (the script itself asserts all of this).  Marked slow for tier-1 wall
+    budget: the same recovery paths run fast in tests/test_resilience.py
+    (test_resilientfit_resumes_preemption_in_process + the serving chaos
+    tests); this adds the full narrated-report round-trip on top."""
+    run_example("ac_resilient.py")

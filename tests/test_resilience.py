@@ -1,0 +1,565 @@
+"""Resilience subsystem (tensordiffeq_tpu.resilience): every chaos fault
+driven through its recovery path on CPU.
+
+divergence -> rollback -> remedy -> converge | preemption -> final
+checkpoint -> auto-resume | torn checkpoint -> checksum fallback | serving
+faults -> retry / breaker / deadline | bucket compile failure ->
+quarantine — plus the chaos-off no-op guarantee (bit-identical training).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
+                              grad)
+from tensordiffeq_tpu.checkpoint import (CheckpointCorrupted,
+                                         checkpoint_exists,
+                                         restore_checkpoint, save_checkpoint,
+                                         verify_checkpoint)
+from tensordiffeq_tpu.resilience import (Chaos, CircuitBreaker,
+                                         CircuitOpenError, Preempted,
+                                         PreemptionHandler, ResilientFit,
+                                         RetryPolicy, active_chaos,
+                                         auto_resume, clear_preemption,
+                                         retry_call)
+from tensordiffeq_tpu.serving import RequestBatcher, RequestTimeout
+from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger,
+                                        TrainingDiverged, read_events)
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption_flag():
+    clear_preemption()
+    yield
+    clear_preemption()
+
+
+def make_solver(n_f=128, seed=0, lr=0.005):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(n_f, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=seed)
+    s.compile([2, 8, 8, 1], f_model, domain, bcs, Adaptive_type=1,
+              dict_adaptive={"residual": [True], "BCs": [True, False, False]},
+              init_weights={"residual": [np.random.RandomState(0).rand(n_f, 1)],
+                            "BCs": [np.random.RandomState(1).rand(16, 1),
+                                    None, None]},
+              lr=lr, fused=False)  # generic engine: faster compiles, same paths
+    return s
+
+
+def leaves(tree):
+    import jax
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def query_points(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.uniform(-1, 1, n),
+                     rng.uniform(0, 1, n)], -1).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# chaos plan: spec parsing, scoping, no-op guarantee
+# --------------------------------------------------------------------------- #
+def test_chaos_spec_roundtrip_and_scoping():
+    c = Chaos.from_spec("nan_epoch=60,preempt_epoch=150,"
+                        "serving_fail_rate=0.25,seed=3,"
+                        "compile_fail_buckets=64+128")
+    assert c.nan_epoch == 60 and c.preempt_epoch == 150
+    assert c.serving_fail_rate == 0.25 and c.seed == 3
+    assert c.compile_fail_buckets == (64, 128)
+    assert Chaos.from_spec(c.spec()).spec() == c.spec()
+    assert active_chaos() is None
+    with c:
+        assert active_chaos() is c
+        inner = Chaos(seed=9)
+        with inner:
+            assert active_chaos() is inner  # innermost wins
+        assert active_chaos() is c
+    assert active_chaos() is None
+    with pytest.raises(ValueError, match="key=value"):
+        Chaos.from_spec("nan_epoch:60")
+    with pytest.raises(ValueError, match="serving_fail_rate"):
+        Chaos(serving_fail_rate=1.5)
+
+
+def test_chaos_off_training_is_bit_identical():
+    """The no-op overhead contract: a ResilientFit-supervised run with no
+    chaos active produces the SAME bits as a plain fit — the resilience
+    wiring costs nothing numerically."""
+    import tempfile
+
+    plain = make_solver()
+    plain.fit(tf_iter=20, newton_iter=0, chunk=10)
+
+    sup = make_solver()
+    with tempfile.TemporaryDirectory() as d:
+        ResilientFit(sup, os.path.join(d, "ck"), checkpoint_every=10).fit(
+            tf_iter=20, newton_iter=0, chunk=10)
+    assert len(sup.losses) == len(plain.losses) == 20
+    for a, b in zip(leaves(plain.params), leaves(sup.params)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        np.asarray(plain.lambdas["residual"][0]),
+        np.asarray(sup.lambdas["residual"][0]))
+
+
+def test_chaos_off_hooks_are_cheap():
+    """The per-boundary check with no plan active is one stack probe —
+    10k calls must be effectively free (a generous bound; any real
+    overhead regression blows straight past it)."""
+    import time
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        assert active_chaos() is None
+    assert time.perf_counter() - t0 < 1.0
+
+
+# --------------------------------------------------------------------------- #
+# divergence -> rollback -> remedy -> converge
+# --------------------------------------------------------------------------- #
+def test_divergence_rollback_remedy_converges(tmp_path):
+    run_dir = str(tmp_path / "run")
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    lr0 = s.lr
+    with RunLogger(run_dir, registry=MetricsRegistry()) as logger:
+        with Chaos(nan_epoch=30, seed=0) as c:
+            rf = ResilientFit(s, ck, checkpoint_every=10, max_retries=3,
+                              telemetry=logger)
+            rf.fit(tf_iter=40, newton_iter=0, chunk=10)
+    assert c.fired["nan"] == 1
+    assert rf.recoveries == 1
+    assert len(s.losses) == 40                       # full budget delivered
+    assert np.isfinite(s.losses[-1]["Total Loss"])   # and it converged
+    assert s.lr != lr0                               # first rung: LR backoff
+    kinds = {e["kind"] for e in read_events(run_dir)}
+    for expected in ("chaos", "divergence", "rollback", "remedy",
+                     "checkpoint", "recovered"):
+        assert expected in kinds, f"missing {expected} event in run log"
+    # the NaN epochs were rolled back out of the history, not kept
+    assert all(np.isfinite(row["Total Loss"]) for row in s.losses)
+
+
+def test_remedy_ladder_walks_all_rungs(tmp_path):
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    with Chaos(nan_epoch=15, nan_repeats=3, seed=0) as c:
+        rf = ResilientFit(s, ck, checkpoint_every=10, max_retries=3)
+        # 50 epochs leave room for three firings (each re-armed rollback
+        # lands ON the fired boundary; the final boundary never injects)
+        rf.fit(tf_iter=50, newton_iter=0, chunk=10)
+    assert c.fired["nan"] == 3
+    assert rf.recoveries == 3
+    assert rf._grad_clip_active is not None   # third rung reached
+    assert len(s.losses) == 50
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+
+
+def test_recovery_budget_exhaustion_reraises(tmp_path):
+    s = make_solver()
+    with Chaos(nan_epoch=15, nan_repeats=10, seed=0):
+        rf = ResilientFit(s, str(tmp_path / "ck"), checkpoint_every=10,
+                          max_retries=1)
+        with pytest.raises(TrainingDiverged):
+            rf.fit(tf_iter=40, newton_iter=0, chunk=10)
+    assert rf.recoveries == 2  # the budgeted one + the re-raised one
+
+
+# --------------------------------------------------------------------------- #
+# preemption: graceful flush, resumable status, auto-resume
+# --------------------------------------------------------------------------- #
+def test_sigterm_flushes_checkpoint_and_raises_resumable(tmp_path):
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    with PreemptionHandler(deadline_s=30.0) as ph:
+        os.kill(os.getpid(), signal.SIGTERM)   # a real delivered signal
+        assert ph.requested
+        with pytest.raises(Preempted) as ei:
+            s.fit(tf_iter=20, newton_iter=0, chunk=10,
+                  checkpoint_dir=ck, checkpoint_every=10)
+    assert ei.value.phase == "adam" and ei.value.epoch == 10
+    assert ei.value.flush_s is not None
+    assert checkpoint_exists(ck)
+    s2 = make_solver(seed=1)
+    s2.restore_checkpoint(ck)
+    assert len(s2.losses) == 10   # the final flush, not a stale periodic one
+
+
+def test_chaos_preemption_auto_resume_matches_uninterrupted(tmp_path):
+    ck = str(tmp_path / "ck")
+    ctrl = make_solver()
+    ctrl.fit(tf_iter=20, newton_iter=0, chunk=10)
+
+    a = make_solver()
+    with Chaos(preempt_epoch=10, seed=0):
+        with pytest.raises(Preempted) as ei:
+            a.fit(tf_iter=20, newton_iter=0, chunk=10,
+                  checkpoint_dir=ck, checkpoint_every=10)
+    assert ei.value.epoch == 10
+
+    # fresh-process analogue: auto_resume with the ORIGINAL total budget
+    b = make_solver(seed=1)
+    auto_resume(b, ck, tf_iter=20, checkpoint_every=10, chunk=10)
+    assert len(b.losses) == 20
+    for l1, l2 in zip(leaves(ctrl.params), leaves(b.params)):
+        np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-6)
+
+
+def test_preemption_during_lbfgs_flushes_progress(tmp_path):
+    """A request pending when the refinement phase hits its first chunk
+    boundary flushes the L-BFGS progress UNCONDITIONALLY (the cadence-gated
+    periodic hook would have skipped that boundary) and raises."""
+    from tensordiffeq_tpu.resilience import request_preemption
+
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    request_preemption()
+    with pytest.raises(Preempted) as ei:
+        s.fit(tf_iter=0, newton_iter=150, checkpoint_dir=ck,
+              checkpoint_every=1000)  # cadence would never fire
+    assert ei.value.phase == "l-bfgs"
+    assert ei.value.epoch == 100       # the loop's first chunk boundary
+    s2 = make_solver(seed=1)
+    s2.restore_checkpoint(ck)
+    assert s2.newton_done == 100       # refinement progress survived
+    assert len(s2.losses) == 0
+
+
+def test_auto_resume_from_empty_dir_starts_fresh(tmp_path):
+    s = make_solver()
+    auto_resume(s, str(tmp_path / "none"), tf_iter=10, checkpoint_every=10,
+                chunk=10)
+    assert len(s.losses) == 10
+
+
+def test_resilientfit_resumes_preemption_in_process(tmp_path):
+    """The acceptance-criteria E2E demo: ONE supervised run survives both a
+    chaos NaN and a chaos preemption, completes its budget, and its run
+    log holds the full failure->healing trail."""
+    run_dir = str(tmp_path / "run")
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    with RunLogger(run_dir, registry=MetricsRegistry()) as logger:
+        with Chaos(nan_epoch=15, preempt_epoch=25, seed=0) as c:
+            rf = ResilientFit(s, ck, checkpoint_every=10, max_retries=2,
+                              telemetry=logger, resume_on_preemption=True)
+            rf.fit(tf_iter=40, newton_iter=0, chunk=10)
+    assert c.fired["nan"] == 1 and c.fired["preempt"] == 1
+    assert rf.recoveries == 1 and rf.preemptions_resumed == 1
+    assert len(s.losses) == 40
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+    kinds = [e["kind"] for e in read_events(run_dir)]
+    for expected in ("divergence", "rollback", "remedy", "checkpoint",
+                     "preempt", "resume"):
+        assert expected in kinds, f"missing {expected} event in run log"
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint: checksum validation, torn-write fallback, K=2 retention
+# --------------------------------------------------------------------------- #
+def _raw_state(v: float):
+    return {"a": np.full((4, 3), v, np.float32),
+            "nested": {"b": np.float32(v)}}
+
+
+def _corrupt_payload(gen_dir):
+    """Garble the largest payload file of one checkpoint generation (works
+    for both the flax single-file and the orbax directory-tree backends)."""
+    victim = max((os.path.join(r, f) for r, _, fs in os.walk(gen_dir)
+                  for f in fs if f != "tdq_meta.json"),
+                 key=os.path.getsize)
+    with open(victim, "r+b") as fh:
+        fh.seek(0)
+        fh.write(b"\xde\xad\xbe\xef")
+
+
+def test_checkpoint_keeps_previous_generation(tmp_path):
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, _raw_state(1.0), meta={"gen": 1})
+    save_checkpoint(p, _raw_state(2.0), meta={"gen": 2})
+    assert os.path.exists(os.path.join(p + ".old", "tdq_meta.json"))
+    out, meta = restore_checkpoint(p, _raw_state(0.0))
+    assert meta["gen"] == 2 and out["a"][0, 0] == 2.0
+
+
+def test_checksum_detects_corruption_and_falls_back(tmp_path):
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, _raw_state(1.0), meta={"gen": 1})
+    save_checkpoint(p, _raw_state(2.0), meta={"gen": 2})
+    # storage-level corruption of the PROMOTED current generation
+    _corrupt_payload(p)
+    with pytest.raises(ValueError, match="checksum"):
+        verify_checkpoint(p)
+    out, meta = restore_checkpoint(p, _raw_state(0.0))  # falls back intact
+    assert meta["gen"] == 1 and out["a"][0, 0] == 1.0
+
+
+def test_chaos_torn_checkpoint_falls_back(tmp_path):
+    p = str(tmp_path / "ck")
+    with Chaos(torn_checkpoint_nth=2, seed=0) as c:
+        save_checkpoint(p, _raw_state(1.0), meta={"gen": 1})
+        save_checkpoint(p, _raw_state(2.0), meta={"gen": 2})  # torn
+    assert c.fired["torn_checkpoint"] == 1
+    out, meta = restore_checkpoint(p, _raw_state(0.0))
+    assert meta["gen"] == 1 and out["a"][0, 0] == 1.0
+
+
+def test_all_generations_corrupt_raises_structured(tmp_path):
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, _raw_state(1.0), meta={"gen": 1})
+    save_checkpoint(p, _raw_state(2.0), meta={"gen": 2})
+    for d in (p, p + ".old"):
+        _corrupt_payload(d)
+    with pytest.raises(CheckpointCorrupted) as ei:
+        restore_checkpoint(p, _raw_state(0.0))
+    assert len(ei.value.failures) == 2
+
+
+def test_solver_restore_survives_torn_current_generation(tmp_path):
+    ck = str(tmp_path / "ck")
+    s = make_solver()
+    s.fit(tf_iter=10, newton_iter=0, chunk=5, checkpoint_dir=ck,
+          checkpoint_every=5)  # two generations: epoch 5 (.old) + epoch 10
+    victim = max((os.path.join(dp, f) for dp, _, fs in os.walk(ck)
+                  for f in fs if f != "tdq_meta.json"), key=os.path.getsize)
+    with open(victim, "r+b") as fh:
+        fh.truncate(max(os.path.getsize(victim) // 2, 1))
+    s2 = make_solver(seed=1)
+    s2.restore_checkpoint(ck)        # falls back to the epoch-5 generation
+    assert len(s2.losses) == 5
+    s2.fit(tf_iter=5, newton_iter=0, chunk=5)  # and it trains on
+    assert np.isfinite(s2.losses[-1]["Total Loss"])
+
+
+# --------------------------------------------------------------------------- #
+# serving: retry, breaker, per-request deadline, bucket quarantine
+# --------------------------------------------------------------------------- #
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(dt, 1e-4)
+
+
+def test_retry_call_recovers_and_is_deterministic():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    slept = []
+    reg = MetricsRegistry()
+    out = retry_call(flaky, RetryPolicy(max_attempts=4, seed=7),
+                     sleep=slept.append, registry=reg, name="test")
+    assert out == "ok" and calls["n"] == 3 and len(slept) == 2
+    # seeded jitter: a fresh policy with the same seed replays the
+    # identical backoff schedule
+    twin = RetryPolicy(max_attempts=4, seed=7)
+    assert slept == [twin.delay_s(1), twin.delay_s(2)]
+    d = reg.as_dict()["counters"]
+    assert d["resilience.retry.attempts{op=test}"] == 2
+    assert d["resilience.retry.recovered{op=test}"] == 1
+
+    def always_bad():
+        raise ValueError("structural")
+
+    with pytest.raises(ValueError):
+        retry_call(always_bad, RetryPolicy(max_attempts=2, retry_on=(KeyError,)),
+                   sleep=lambda s: None, registry=reg)
+
+
+def test_batcher_retries_injected_serving_faults():
+    def op(X):
+        return X[:, :1] * 2.0
+
+    reg = MetricsRegistry()
+    b = RequestBatcher(op=op, max_batch=100,
+                       retry=RetryPolicy(max_attempts=4, base_delay_s=0.0,
+                                         jitter=0.0),
+                       sleep=lambda s: None, registry=reg)
+    with Chaos(serving_fail_n=2, seed=0) as c:
+        h = b.submit(query_points(3))
+        b.flush()
+    np.testing.assert_allclose(h.result(), query_points(3)[:, :1] * 2.0)
+    s = b.stats()
+    assert s["requests"] == 1 and s["failed"] == 0
+    assert s["retried_ok"] == 1
+    assert c.fired["serving"] == 2  # both injected faults were absorbed
+
+
+def test_breaker_opens_fast_fails_and_recovers():
+    clock = FakeClock()
+    dead = {"on": True}
+
+    def op(X):
+        if dead["on"]:
+            raise RuntimeError("backend down")
+        return X[:, :1]
+
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=1.0,
+                        clock=clock, registry=MetricsRegistry())
+    b = RequestBatcher(op=op, max_batch=100, breaker=br, clock=clock,
+                       sleep=clock.sleep, request_timeout_s=50.0)
+    for _ in range(2):  # two failing batches open the circuit
+        b.submit(query_points(1))
+        with pytest.raises(RuntimeError, match="backend down"):
+            b.flush()
+    assert br.state == "open"
+    h = b.submit(query_points(1))           # fast-fail, no queue pileup
+    assert h.done
+    with pytest.raises(CircuitOpenError):
+        h.result()
+    assert b.stats()["rejected"] == 1
+    clock.t += 1.1                          # cool-down elapses
+    dead["on"] = False                      # backend healed
+    h2 = b.submit(query_points(2))          # half-open probe admitted
+    b.flush()
+    assert h2.result().shape == (2, 1)
+    assert br.state == "closed"
+
+
+def test_waiter_deadline_no_hung_callers():
+    """A waiter queued behind a breaker that is stuck open times out with a
+    structured error — it never blocks forever."""
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=1000.0,
+                        clock=clock, registry=MetricsRegistry())
+    b = RequestBatcher(op=lambda X: X[:, :1], max_batch=100, breaker=br,
+                       clock=clock, sleep=clock.sleep, request_timeout_s=0.5)
+    # another client's op failure opens the shared breaker; this batcher's
+    # queued waiter is now stuck behind an open circuit
+    h = b.submit(query_points(1))
+    br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(RequestTimeout) as ei:
+        h.result()
+    assert ei.value.waited_s >= 0.5
+    assert b.stats()["timed_out"] == 1
+    assert clock.t < 10.0  # bounded wait, not the 1000 s breaker window
+
+
+def test_poll_sweeps_expired_waiters():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=1000.0,
+                        clock=clock, registry=MetricsRegistry())
+    b = RequestBatcher(op=lambda X: X[:, :1], max_batch=100, breaker=br,
+                       clock=clock, sleep=clock.sleep, request_timeout_s=0.5)
+    h = b.submit(query_points(2))
+    br.record_failure()
+    clock.t = 1.0
+    b.poll()             # event-loop path: sweeps without blocking anyone
+    assert h.done
+    with pytest.raises(RequestTimeout):
+        h.result()
+
+
+def test_empty_flush_does_not_consume_half_open_probe():
+    """Regression: flush() on an EMPTY queue must not consult the breaker —
+    allow() on a cooled-down open circuit consumes the single half-open
+    probe slot, and with no op outcome to release it the breaker would
+    wedge half-open forever (every later request timing out even though
+    the backend recovered)."""
+    clock = FakeClock()
+    dead = {"on": True}
+
+    def op(X):
+        if dead["on"]:
+            raise RuntimeError("down")
+        return X[:, :1]
+
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                        clock=clock, registry=MetricsRegistry())
+    b = RequestBatcher(op=op, max_batch=100, breaker=br, clock=clock,
+                       sleep=clock.sleep, request_timeout_s=50.0)
+    b.submit(query_points(1))
+    with pytest.raises(RuntimeError):
+        b.flush()
+    assert br.state == "open"
+    clock.t += 1.1          # cool-down elapses
+    b.flush()               # empty queue: must NOT consume the probe slot
+    assert br.state == "open"
+    dead["on"] = False
+    h = b.submit(query_points(2))   # the real probe
+    b.flush()
+    assert h.result().shape == (2, 1)
+    assert br.state == "closed"
+
+
+def test_config_mismatch_is_not_absorbed_by_fallback(tmp_path):
+    """Regression: a wrong-config template must raise TemplateMismatch —
+    never be misread as corruption and silently fall back to the previous
+    generation (which has the same config problem)."""
+    from tensordiffeq_tpu.checkpoint import TemplateMismatch
+
+    p = str(tmp_path / "ck")
+    save_checkpoint(p, _raw_state(1.0), meta={"gen": 1})
+    save_checkpoint(p, _raw_state(2.0), meta={"gen": 2})
+    wrong = {"a": np.zeros((8, 2), np.float32),       # wrong leaf shape
+             "nested": {"b": np.float32(0.0)}}
+    with pytest.raises(TemplateMismatch, match="different configuration"):
+        restore_checkpoint(p, wrong)
+    wrong_structure = {"a": np.zeros((4, 3), np.float32)}  # missing leaf
+    with pytest.raises(TemplateMismatch, match="leaves"):
+        restore_checkpoint(p, wrong_structure)
+
+
+def test_engine_quarantines_failing_bucket_not_engine():
+    s = make_solver()
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    clean = s.export_surrogate().engine(min_bucket=64, max_bucket=256)
+    X = query_points(10)
+    want = clean.u(X)
+
+    eng = s.export_surrogate().engine(min_bucket=64, max_bucket=256)
+    with Chaos(compile_fail_buckets=[64], seed=0) as c:
+        got = eng.u(X)   # 64 fails at first touch -> rerouted to 128
+    assert c.fired["compile"] == 1
+    np.testing.assert_array_equal(got, want)  # same math, more padding
+    assert eng.quarantined_buckets() == {"u": [64]}
+    # the engine keeps serving every kind; the healthy rungs are untouched
+    assert eng.residual(query_points(5)).shape == (5,)
+    np.testing.assert_array_equal(eng.u(query_points(10)), want)
+
+    eng2 = s.export_surrogate().engine(min_bucket=64, max_bucket=128)
+    from tensordiffeq_tpu.serving import EngineDegraded
+    with Chaos(compile_fail_buckets=[64, 128], seed=0):
+        with pytest.raises(EngineDegraded, match="quarantined"):
+            eng2.u(query_points(4))
+
+
+def test_batcher_default_has_no_behavior_change():
+    """Without retry/breaker config the batcher keeps its PR-2 contract:
+    op failures reach every waiter immediately and re-raise."""
+    def op(X):
+        raise RuntimeError("organic failure")
+
+    b = RequestBatcher(op=op, max_batch=100)
+    h1, h2 = b.submit(query_points(2)), b.submit(query_points(3))
+    with pytest.raises(RuntimeError, match="organic failure"):
+        b.flush()
+    for h in (h1, h2):
+        with pytest.raises(RuntimeError, match="organic failure"):
+            h.result()
+    s = b.stats()
+    assert s["requests"] == 0 and s["failed"] == 2 and s["timed_out"] == 0

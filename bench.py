@@ -1013,6 +1013,174 @@ def bench_serving(n_f, nx, nt, widths, on_phase=None):
 
 
 # --------------------------------------------------------------------------- #
+# --fleet: multi-tenant serving through the fleet router (warm start + QPS)
+# --------------------------------------------------------------------------- #
+def fleet_partial(payload):
+    """The salvageable warm-start-phase line for --fleet (same rule as
+    serving_partial): if the multi-tenant QPS phase dies, the cold-vs-warm
+    first-query measurement already taken must survive as a REAL headline,
+    with the fallback disclosed in the metric string."""
+    return dict(
+        payload,
+        metric="fleet warm-start first-query speedup "
+               "(multi-tenant QPS phase incomplete)",
+        value=payload["warm_start"]["speedup"],
+        unit="x (cold / warm first-query latency)",
+        note="multi-tenant QPS phase did not complete; warm-start "
+             "measurement only")
+
+
+def bench_fleet(n_f, nx, nt, widths, on_phase=None):
+    """Measure the fleet layer end-to-end:
+
+    * **warm-start phase** — export two AOT fleet artifacts, then price
+      the cold-start tax: first-query latency of a cold engine (jit storm
+      at request time) vs a :class:`FleetRouter`-loaded tenant (AOT warm
+      start at load time).  The per-bucket compile counters prove the
+      warm tenant compiled ZERO programs at request time
+      (``request_time_compiles``).
+    * **multi-tenant QPS phase** — the headline: N tenants x mixed
+      u/residual traffic coalesced through per-tenant batchers behind
+      admission control.
+
+    Untrained params (serving cost is shape-, not value-dependent).
+    ``on_phase(payload)`` streams a salvageable line after the warm-start
+    phase — a timeout in the QPS grid must not discard it."""
+    import shutil
+    import tempfile
+
+    from tensordiffeq_tpu import fleet
+    from tensordiffeq_tpu.serving import Surrogate
+    from tensordiffeq_tpu.telemetry import default_registry
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_tenants = 2 if fast else 4
+    min_bucket, max_bucket = (64, 256) if fast else (256, 4096)
+    n_chips = 1  # fleet engines serve unsharded (one tenant ladder/chip)
+
+    work = tempfile.mkdtemp(prefix="tdq_fleet_bench_")
+    tenants, f_models = [], {}
+    try:
+        for i in range(n_tenants):
+            solver = build_solver(n_f, nx, nt, widths, seed=i)
+            art = os.path.join(work, f"tenant{i}")
+            fleet.export_fleet_artifact(
+                solver.export_surrogate(), art,
+                min_bucket=min_bucket, max_bucket=max_bucket)
+            tenants.append((f"t{i}", art))
+            f_models[f"t{i}"] = solver.f_model
+        rng = np.random.RandomState(0)
+
+        def draw(n):
+            return np.stack([rng.uniform(-1.0, 1.0, n),
+                             rng.uniform(0.0, 1.0, n)],
+                            -1).astype(np.float32)
+
+        payload = {
+            "metric": "multi-tenant fleet serving QPS "
+                      f"({n_tenants} tenants, mixed u/residual)",
+            "value": None, "unit": "queries/sec/chip", "vs_baseline": None,
+            "tenants_total": n_tenants,
+            "buckets": list(min_bucket << i for i in range(
+                (max_bucket // min_bucket).bit_length())),
+        }
+
+        # -- warm-start phase: cold engine vs router-warm-started tenant.
+        # Distinct tenants on both sides so no jit cache is shared.
+        reg = default_registry()
+
+        def compile_count():
+            return sum(v for k, v in reg.as_dict()["counters"].items()
+                       if k.startswith("serving.engine.compiles"))
+
+        cold_eng = Surrogate.load(
+            tenants[0][1], f_model=f_models["t0"]).engine(
+                min_bucket=min_bucket, max_bucket=max_bucket)
+        Xq = draw(min_bucket)
+        t0 = time.time()
+        cold_eng.u(Xq)
+        cold_s = time.time() - t0
+
+        router = fleet.FleetRouter(max_loaded=n_tenants)
+        policy = fleet.TenantPolicy(min_bucket=min_bucket,
+                                    max_bucket=max_bucket,
+                                    max_batch=min(1024, max_bucket),
+                                    max_latency_s=0.005)
+        for name, art in tenants:
+            router.register(name, art, policy=policy)
+        t0 = time.time()
+        warm_lt = router.load("t1")
+        warm_load_s = time.time() - t0
+        pre = compile_count()
+        t0 = time.time()
+        router.query("t1", Xq)
+        warm_s = time.time() - t0
+        request_time_compiles = compile_count() - pre
+        payload["warm_start"] = {
+            "cold_first_query_s": round(cold_s, 6),
+            "warm_first_query_s": round(warm_s, 6),
+            "warm_load_s": round(warm_load_s, 6),
+            "speedup": round(cold_s / warm_s, 2) if warm_s > 0 else None,
+            "request_time_compiles": request_time_compiles,
+            "aot_programs": warm_lt.warm.get("aot", 0),
+            "jit_prewarmed": warm_lt.warm.get("jit", 0),
+        }
+        log(f"[fleet] first query: cold {cold_s * 1e3:.1f}ms vs warm "
+            f"{warm_s * 1e3:.1f}ms ({payload['warm_start']['speedup']}x), "
+            f"{request_time_compiles} request-time compiles")
+        if on_phase is not None:
+            on_phase(fleet_partial(payload))
+
+        # -- multi-tenant QPS phase: mixed u/residual traffic, round-robin
+        # tenants, coalesced per (tenant, kind), admission-gated.  All
+        # tenants are loaded (warm) before timing: this prices steady
+        # state, the warm-start phase priced the transient.
+        for name, _ in tenants:
+            router.load(name)
+
+        def served_requests():
+            return sum(s["requests"]
+                       for t in router.stats()["tenants"].values()
+                       if t["loaded"] for s in t["kinds"].values())
+
+        n_req = 200 if fast else 2000
+        sizes = rng.randint(1, 33, size=n_req)
+        kinds = np.where(rng.uniform(size=n_req) < 0.7, "u", "residual")
+        served_before = served_requests()  # the warm-phase probe query
+        t0 = time.time()
+        for i in range(n_req):
+            name = tenants[i % n_tenants][0]
+            router.submit(name, draw(int(sizes[i])), kind=str(kinds[i]))
+            router.poll()
+        router.flush()
+        wall = time.time() - t0
+        stats = router.stats()
+        served = served_requests() - served_before
+        lat = [v for t in stats["tenants"].values() if t["loaded"]
+               for s in t["kinds"].values()
+               for v in [s["latency_s"]] if v.get("p99") is not None]
+        payload.update(
+            value=round(served / wall / n_chips) if wall > 0 else None,
+            requests=served, wall_s=round(wall, 3),
+            latency_p99_s=(round(max(p["p99"] for p in lat), 6)
+                           if lat else None),
+            cache={"hits": stats["hits"], "misses": stats["misses"],
+                   "evictions": stats["evictions"]},
+            per_tenant={
+                t: {k: {"requests": s["requests"],
+                        "qps": (None if s["qps"] is None
+                                else round(s["qps"], 1))}
+                    for k, s in d["kinds"].items()}
+                for t, d in stats["tenants"].items() if d["loaded"]},
+            autoscale=router.autoscale_signals())
+        log(f"[fleet] {served} requests over {n_tenants} tenants in "
+            f"{wall:.2f}s -> {payload['value']:,} QPS")
+        return payload
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------- #
 # --full: real training with periodic L2 evaluation -> time-to-target
 # --------------------------------------------------------------------------- #
 def bench_time_to_l2(n_f, nx, nt, widths, target=2.1e-2,
@@ -1249,6 +1417,16 @@ def worker_main(args):
             print(json.dumps(partial), flush=True)
 
         payload = bench_serving(n_f, nx, nt, widths, on_phase=on_phase)
+    elif args.fleet:
+        # stream per-phase like --serving: a timeout in the QPS grid
+        # still salvages the warm-start measurement
+        def on_phase(partial):
+            import jax
+            partial.setdefault("backend", jax.default_backend())
+            partial.setdefault("device_kind", jax.devices()[0].device_kind)
+            print(json.dumps(partial), flush=True)
+
+        payload = bench_fleet(n_f, nx, nt, widths, on_phase=on_phase)
     elif args.full:
         def full_payload(r):
             p = {"metric":
@@ -1571,9 +1749,13 @@ def main():
                     help="batched surrogate inference: dense-grid u/residual "
                          "rates + coalesced-query QPS through the serving "
                          "subsystem")
+    ap.add_argument("--fleet", action="store_true",
+                    help="multi-tenant fleet serving: cold vs AOT-warm-start "
+                         "first-query latency + N-tenant mixed u/residual "
+                         "QPS through the fleet router")
     ap.add_argument("--mode", choices=["default", "full", "engines",
                                        "precision", "scale", "remat",
-                                       "serving"],
+                                       "serving", "fleet"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
     ap.add_argument("--chaos", metavar="SPEC",
@@ -1595,7 +1777,7 @@ def main():
         return
 
     mode_flags = [f for f in ("--full", "--engines", "--precision", "--scale",
-                              "--remat", "--serving")
+                              "--remat", "--serving", "--fleet")
                   if getattr(args, f.lstrip("-"))]
 
     # Total wall budget.  The driver's no-flag invocation must finish well
@@ -1603,7 +1785,7 @@ def main():
     # explicit modes are watcher-driven with generous budgets of their own.
     default_budget = {"default": 1140, "engines": 2400, "precision": 2400,
                       "scale": 7200, "remat": 2400, "serving": 1800,
-                      "full": 86400}[mode_name(mode_flags)]
+                      "fleet": 1800, "full": 86400}[mode_name(mode_flags)]
     budget = float(os.environ.get("BENCH_BUDGET", default_budget))
     t_start = time.time()
 

@@ -127,11 +127,21 @@ def checkpoint_exists(path: str) -> bool:
                for c in (path, path + ".old"))
 
 
-def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
+def save_checkpoint(path: str, state: dict, meta: dict | None = None,
+                    extra_files: dict | None = None) -> None:
     """Write ``state`` (a pytree dict) under directory ``path``.
 
     ``meta`` is an optional JSON-serialisable dict stored alongside (losses
     history, iteration counters, …).
+
+    ``extra_files`` maps checkpoint-relative paths to raw ``bytes`` written
+    alongside the state (e.g. the fleet layer's serialized AOT programs,
+    ``aot/u_256.bin``).  They land in the same ``.tmp`` staging directory
+    BEFORE the content checksum is computed, so they ride the full
+    crash-safety protocol: fsynced, checksummed, atomically swapped, and
+    validated by :func:`restore_checkpoint` exactly like the state payload
+    — a torn AOT blob fails the whole generation instead of silently
+    serving a corrupt program.
 
     The write is crash-safe: everything lands in a ``<path>.tmp`` sibling
     first (payloads fsynced, content checksum embedded in the meta), then
@@ -163,6 +173,18 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None) -> None:
         import flax.serialization
         with open(os.path.join(tmp, _FLAX_FILE), "wb") as fh:
             fh.write(flax.serialization.to_bytes(state))
+    for rel, blob in (extra_files or {}).items():
+        rel = os.path.normpath(rel)
+        if os.path.isabs(rel) or rel.startswith(".."):
+            raise ValueError(f"extra file path {rel!r} escapes the "
+                             "checkpoint directory")
+        if os.path.basename(rel) == _META:
+            raise ValueError(f"extra file {rel!r} would shadow the "
+                             "checkpoint meta")
+        dest = os.path.join(tmp, rel)
+        os.makedirs(os.path.dirname(dest) or tmp, exist_ok=True)
+        with open(dest, "wb") as fh:
+            fh.write(bytes(blob))
     with open(os.path.join(tmp, _META), "w") as fh:
         json.dump({"backend": backend, "meta": meta or {},
                    # restores compare these against the caller's template

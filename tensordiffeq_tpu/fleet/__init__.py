@@ -1,0 +1,43 @@
+"""Multi-tenant serving fleet: LRU artifact cache, admission control,
+AOT warm start.
+
+``serving/`` deploys ONE surrogate; this package deploys MANY — the
+"millions of users" direction of the roadmap made concrete.  A
+:class:`FleetRouter` hot-loads surrogate artifacts behind a bounded LRU
+cache (evicted engines drop their jit ladders; reloads go through the
+checksum-validated checkpoint restore), gives every tenant its own
+coalescing batchers with their own retry/breaker/deadline policy
+(:class:`TenantPolicy`), sheds overload at the front door with a
+structured :class:`AdmissionRejected` (:class:`AdmissionController`:
+token-bucket rate limits, queue bounds, priority-ordered load shedding),
+and kills the fresh-replica cold-start tax with an AOT warm start
+(:func:`export_fleet_artifact` / :func:`warm_start`: ``jax.export``-
+serialized per-rung programs riding the artifact, persistent-compile-
+cache prewarm as the fallback).  Autoscaling signals — queue-depth
+gauges, latency histograms, cache hit/miss/eviction counters — publish
+through the shared telemetry registry
+(:meth:`FleetRouter.autoscale_signals` distils them).
+
+Typical flow::
+
+    # train side, once per tenant:
+    from tensordiffeq_tpu import fleet
+    fleet.export_fleet_artifact(solver.export_surrogate(), "runs/ac",
+                                min_bucket=64, max_bucket=4096)
+
+    # serving replica (fresh process):
+    router = fleet.FleetRouter(max_loaded=8)
+    router.register("ac", "runs/ac",
+                    policy=fleet.TenantPolicy(min_bucket=64,
+                                              max_bucket=4096,
+                                              rate_qps=500.0))
+    router.load("ac")                    # warm start: zero request-time
+    u = router.query("ac", X)            # compiles from here on
+"""
+
+from .admission import (PRIORITIES, AdmissionController,  # noqa: F401
+                        AdmissionRejected)
+from .router import (FleetRouter, LoadedTenant,  # noqa: F401
+                     TenantEvicted, TenantPolicy)
+from .warmstart import (AOT_SUBDIR, DEFAULT_KINDS,  # noqa: F401
+                        export_fleet_artifact, warm_start)

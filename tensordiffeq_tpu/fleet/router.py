@@ -1,0 +1,444 @@
+"""The multi-tenant fleet router: many surrogates, one front door.
+
+One trained surrogate per PDE/config is the breadth direction PINNs-TF2
+(arXiv:2311.03626) motivates on the training side; a real deployment
+hosts MANY of them at once behind one process.  :class:`FleetRouter`
+composes the pieces the previous PRs built into that layer:
+
+* a **bounded LRU artifact cache** — at most ``max_loaded`` tenants hold
+  live engines (each engine owns a jit ladder of compiled programs, the
+  scarce resource); the least-recently-used tenant is evicted to make
+  room, its pending batches flushed and its jit ladder dropped.  A
+  reload goes back through the checksum-validated restore path
+  (:mod:`tensordiffeq_tpu.checkpoint`), and the evicted engine's bucket
+  quarantine is carried across the reload — a rung that failed to
+  compile is NOT resurrected as healthy just because memory pressure
+  cycled the tenant.
+* **per-tenant serving policy** — each tenant's
+  :class:`~tensordiffeq_tpu.serving.RequestBatcher` set (one per query
+  kind) runs under its own :class:`~tensordiffeq_tpu.resilience.RetryPolicy`,
+  :class:`~tensordiffeq_tpu.resilience.CircuitBreaker` and request
+  deadline (:class:`TenantPolicy`): one tenant's dying backend opens one
+  tenant's breaker.
+* **admission before queue** — every submit passes the
+  :class:`~tensordiffeq_tpu.fleet.AdmissionController` BEFORE anything
+  is enqueued (or even loaded), so overload sheds with a structured
+  :class:`~tensordiffeq_tpu.fleet.AdmissionRejected` at the front door
+  instead of collapsing the queues behind it.
+* **AOT warm start** — ``load()`` runs the
+  :func:`~tensordiffeq_tpu.fleet.warm_start` ladder, so a freshly loaded
+  tenant answers its first query without compiling anything at request
+  time.
+* **autoscaling signals** — per-tenant queue-depth gauges, latency
+  histograms and cache hit/miss/eviction counters all land in the
+  shared :func:`~tensordiffeq_tpu.telemetry.default_registry` (tenant-
+  labeled via registry scopes); :meth:`autoscale_signals` distils the
+  scale-up/down inputs an operator loop polls.
+
+With no chaos active, a fleet-served query is bit-identical to the same
+query against a direct :class:`~tensordiffeq_tpu.serving.InferenceEngine`
+over the same artifact (``tests/test_fleet.py`` pins this).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.chaos import active_chaos
+from ..serving.batcher import RequestBatcher
+from ..serving.surrogate import Surrogate
+from ..telemetry import default_registry, log_event
+from .admission import AdmissionController
+from .warmstart import warm_start
+
+
+class TenantEvicted(RuntimeError):
+    """Delivered to waiters whose coalesced batch could not execute
+    (circuit breaker open) before their tenant was evicted — a
+    structured immediate failure instead of a deadline spin against an
+    engine that no longer exists."""
+
+    def __init__(self, tenant: str):
+        self.tenant = str(tenant)
+        super().__init__(
+            f"tenant {tenant!r} was evicted before this request's batch "
+            "could execute (circuit open at eviction); resubmit to "
+            "trigger a reload")
+
+
+class TenantPolicy:
+    """One tenant's serving-policy knobs (engine shape, batching,
+    resilience, admission).  Pure configuration — safe to share between
+    tenants that want identical policy.
+
+    Args:
+      min_bucket / max_bucket / shard: the tenant engine's pad-to-bucket
+        ladder (see :class:`~tensordiffeq_tpu.serving.InferenceEngine`).
+      max_batch / max_latency_s: the tenant batchers' coalescing policy.
+      retry: optional :class:`~tensordiffeq_tpu.resilience.RetryPolicy`
+        for this tenant's batchers (shared across its query kinds).
+      breaker_failure_threshold / breaker_reset_timeout_s: when the
+        threshold is not None, each *load* of this tenant gets its own
+        :class:`~tensordiffeq_tpu.resilience.CircuitBreaker` (named
+        ``fleet.<tenant>``) shared across its query-kind batchers.
+      request_timeout_s: per-request deadline (None disables — serve
+        with one).
+      rate_qps / burst / max_queue_points / priority: the tenant's
+        admission-control contract (see
+        :class:`~tensordiffeq_tpu.fleet.AdmissionController`).
+      warm_start: prewarm the engine ladder at load time (the fleet
+        default).  ``False`` loads cold — first queries pay jit compiles
+        at request time (what ``bench.py --fleet`` prices the warm path
+        against).
+      warm_kinds: query kinds to prewarm when the artifact carries no
+        warm-start block (v1 artifacts); an artifact block's own kinds
+        win when present.
+    """
+
+    def __init__(self, *, min_bucket: int = 256, max_bucket: int = 1 << 20,
+                 shard: bool = False, max_batch: int = 4096,
+                 max_latency_s: float = 0.01, retry=None,
+                 breaker_failure_threshold: Optional[int] = None,
+                 breaker_reset_timeout_s: float = 30.0,
+                 request_timeout_s: Optional[float] = 30.0,
+                 rate_qps: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 max_queue_points: Optional[int] = None,
+                 priority: int = 1, warm_start: bool = True,
+                 warm_kinds: Optional[Sequence[str]] = None):
+        self.min_bucket = int(min_bucket)
+        self.max_bucket = int(max_bucket)
+        self.shard = bool(shard)
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_s)
+        self.retry = retry
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_reset_timeout_s = float(breaker_reset_timeout_s)
+        self.request_timeout_s = request_timeout_s
+        self.rate_qps = rate_qps
+        self.burst = burst
+        self.max_queue_points = max_queue_points
+        self.priority = int(priority)
+        self.warm_start = bool(warm_start)
+        self.warm_kinds = None if warm_kinds is None else list(warm_kinds)
+
+
+class _Registration:
+    """What the router remembers about a tenant across load/evict cycles."""
+
+    __slots__ = ("artifact", "f_model", "net", "policy", "quarantine")
+
+    def __init__(self, artifact, f_model, net, policy):
+        self.artifact = artifact
+        self.f_model = f_model
+        self.net = net
+        self.policy = policy
+        self.quarantine: list = []  # engine.quarantine_snapshot() carryover
+
+
+class LoadedTenant:
+    """A live tenant: surrogate + engine + per-kind batchers + breaker."""
+
+    def __init__(self, tenant: str, surrogate: Surrogate, engine,
+                 policy: TenantPolicy, registry, clock, warm: dict):
+        self.tenant = tenant
+        self.surrogate = surrogate
+        self.engine = engine
+        self.policy = policy
+        self.warm = warm
+        self._registry = registry
+        self._clock = clock
+        self.breaker = None
+        if policy.breaker_failure_threshold is not None:
+            self.breaker = CircuitBreaker(
+                failure_threshold=policy.breaker_failure_threshold,
+                reset_timeout_s=policy.breaker_reset_timeout_s,
+                name=f"fleet.{tenant}", clock=clock, registry=registry)
+        self._batchers: "OrderedDict[str, RequestBatcher]" = OrderedDict()
+
+    def batcher(self, kind: str = "u") -> RequestBatcher:
+        """The tenant's coalescing batcher for one query kind (created
+        lazily; all kinds share the tenant's breaker + retry policy)."""
+        spec = self.engine.spec_for(self.engine.kind_key(kind))
+        b = self._batchers.get(spec)
+        if b is None:
+            b = self._batchers[spec] = RequestBatcher(
+                op=self.engine.op_for(spec),
+                max_batch=self.policy.max_batch,
+                max_latency_s=self.policy.max_latency_s,
+                retry=self.policy.retry, breaker=self.breaker,
+                request_timeout_s=self.policy.request_timeout_s,
+                clock=self._clock,
+                registry=self._registry.scope(kind=spec))
+        return b
+
+    def pending_points(self) -> int:
+        return sum(b.pending_points for b in self._batchers.values())
+
+    def flush(self) -> None:
+        """Flush every kind's pending batch (failures are delivered to
+        their waiters by the batcher itself)."""
+        for b in self._batchers.values():
+            try:
+                b.flush()
+            except Exception:
+                pass  # waiters already hold the failure
+
+    def drain(self) -> None:
+        """Eviction-time flush: try to execute pending batches, then
+        fail-fast whatever could NOT run (an open breaker makes
+        ``flush()`` a no-op that keeps the queue) — no waiter may be
+        left spinning against an engine that is being dropped."""
+        self.flush()
+        for b in self._batchers.values():
+            if b.pending_points:
+                b.fail_pending(TenantEvicted(self.tenant))
+
+    def poll(self) -> bool:
+        return any([b.poll() for b in self._batchers.values()])
+
+    def stats(self) -> dict:
+        return {spec: b.stats() for spec, b in self._batchers.items()}
+
+
+class FleetRouter:
+    """Route multi-tenant surrogate queries; see the module docstring.
+
+    Args:
+      max_loaded: LRU bound on concurrently live tenants (engines).
+      admission: an :class:`~tensordiffeq_tpu.fleet.AdmissionController`
+        (one is built with defaults when omitted; pass your own to tune
+        fleet-wide capacity).
+      registry: metrics destination (default: the shared process
+        registry).  Per-tenant instruments are tenant-labeled scopes of
+        it.
+      clock: time source, injectable for tests (threads through
+        batchers, breakers and the admission controller built here).
+    """
+
+    def __init__(self, max_loaded: int = 4,
+                 admission: Optional[AdmissionController] = None,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_loaded < 1:
+            raise ValueError(f"max_loaded must be >= 1, got {max_loaded}")
+        self.max_loaded = int(max_loaded)
+        self._registry = (registry if registry is not None
+                          else default_registry())
+        self._clock = clock
+        self.admission = (admission if admission is not None
+                          else AdmissionController(clock=clock,
+                                                   registry=self._registry))
+        self._registered: dict = {}
+        self._loaded: "OrderedDict[str, LoadedTenant]" = OrderedDict()
+        self._hits = self._misses = self._evictions = 0
+
+    # ------------------------------------------------------------------ #
+    def register(self, tenant: str, artifact: str, *, f_model=None,
+                 net=None, policy: Optional[TenantPolicy] = None) -> None:
+        """Register a tenant: artifact path + user code (``f_model``,
+        custom ``net``) + policy.  Registration is cheap — nothing loads
+        until the first query (or an explicit :meth:`load`).
+        Re-registering replaces the entry (a live instance is evicted
+        first: the old artifact must not keep serving)."""
+        if tenant in self._loaded:
+            self.evict(tenant)
+        self._registered[tenant] = _Registration(
+            str(artifact), f_model, net, policy or TenantPolicy())
+        self.admission.configure(
+            tenant,
+            rate_qps=self._registered[tenant].policy.rate_qps,
+            burst=self._registered[tenant].policy.burst,
+            max_queue_points=self._registered[tenant].policy.max_queue_points,
+            priority=self._registered[tenant].policy.priority)
+
+    def tenants(self) -> tuple:
+        return tuple(self._registered)
+
+    def loaded(self) -> tuple:
+        """Live tenants, LRU-first (the leftmost is next to evict)."""
+        return tuple(self._loaded)
+
+    def _reg(self, tenant: str) -> _Registration:
+        reg = self._registered.get(tenant)
+        if reg is None:
+            raise KeyError(
+                f"tenant {tenant!r} is not registered (known: "
+                f"{sorted(self._registered)})")
+        return reg
+
+    # ------------------------------------------------------------------ #
+    def load(self, tenant: str) -> LoadedTenant:
+        """The tenant's live instance: a cache hit refreshes its LRU slot;
+        a miss evicts down to ``max_loaded - 1``, restores the artifact
+        through the checksum-validated checkpoint path, re-applies the
+        tenant's quarantine memory, and warm-starts the engine."""
+        reg = self._reg(tenant)
+        chaos = active_chaos()
+        if chaos is not None and chaos.on_fleet_access(
+                evictable=bool(self._loaded)):
+            self.evict()
+        lt = self._loaded.get(tenant)
+        if lt is not None:
+            self._loaded.move_to_end(tenant)
+            self._hits += 1
+            self._registry.counter("fleet.cache.hits", tenant=tenant).inc()
+            return lt
+        self._misses += 1
+        self._registry.counter("fleet.cache.misses", tenant=tenant).inc()
+        while len(self._loaded) >= self.max_loaded:
+            self.evict()
+        t0 = self._clock()
+        sur = Surrogate.load(reg.artifact, f_model=reg.f_model, net=reg.net)
+        scope = self._registry.scope(tenant=tenant)
+        engine = sur.engine(min_bucket=reg.policy.min_bucket,
+                            max_bucket=reg.policy.max_bucket,
+                            shard=reg.policy.shard, registry=scope)
+        if reg.quarantine:
+            engine.restore_quarantine(reg.quarantine)
+        warm: dict = {}
+        if reg.policy.warm_start:
+            warm = warm_start(engine, kinds=reg.policy.warm_kinds,
+                              tenant=tenant, registry=self._registry,
+                              max_drive_bucket=reg.policy.max_batch)
+        lt = LoadedTenant(tenant, sur, engine, reg.policy, scope,
+                          self._clock, warm)
+        self._loaded[tenant] = lt
+        load_s = self._clock() - t0
+        self._registry.histogram("fleet.load_s").observe(load_s)
+        self._registry.gauge("fleet.loaded_tenants").set(len(self._loaded))
+        log_event("fleet",
+                  f"loaded tenant={tenant} from {reg.artifact} in "
+                  f"{load_s:.3f}s"
+                  + (f" (warm start: {warm.get('aot', 0)} AOT + "
+                     f"{warm.get('jit', 0)} jit)" if warm else " (cold)"),
+                  verbose=False, event="load", tenant=tenant,
+                  load_s=load_s, warm=bool(warm))
+        return lt
+
+    def evict(self, tenant: Optional[str] = None) -> Optional[str]:
+        """Drop a live tenant (default: the LRU one).  Pending batches
+        are flushed first, the engine's quarantine is snapshotted into
+        the registration (reload carries it), and the jit ladder goes
+        with the engine.  Returns the evicted tenant (None if nothing
+        was loaded)."""
+        if tenant is None:
+            if not self._loaded:
+                return None
+            tenant = next(iter(self._loaded))
+        lt = self._loaded.pop(tenant, None)
+        if lt is None:
+            return None
+        lt.drain()
+        self._reg(tenant).quarantine = lt.engine.quarantine_snapshot()
+        self._evictions += 1
+        self._registry.counter("fleet.cache.evictions",
+                               tenant=tenant).inc()
+        self._registry.gauge("fleet.loaded_tenants").set(len(self._loaded))
+        log_event("fleet",
+                  f"evicted tenant={tenant} (LRU, {len(self._loaded)}/"
+                  f"{self.max_loaded} loaded); jit ladder dropped, "
+                  f"{len(self._reg(tenant).quarantine)} quarantined "
+                  "rung(s) remembered", verbose=False, event="evict",
+                  tenant=tenant, loaded=len(self._loaded))
+        return tenant
+
+    # ------------------------------------------------------------------ #
+    def submit(self, tenant: str, X, kind: str = "u",
+               priority: Optional[int] = None):
+        """Admission-gated submit: the request passes the
+        :class:`AdmissionController` BEFORE the tenant is even loaded —
+        overload never triggers artifact loads, let alone queue growth —
+        then coalesces into the tenant's per-kind batcher.  Returns the
+        batcher's :class:`~tensordiffeq_tpu.serving.PendingQuery` handle;
+        raises :class:`~tensordiffeq_tpu.fleet.AdmissionRejected` when
+        shed."""
+        reg = self._reg(tenant)  # unknown tenants fail before admission
+        n = int(np.atleast_2d(np.asarray(X)).shape[0])
+        lt = self._loaded.get(tenant)
+        self.admission.admit(
+            tenant, n,
+            priority if priority is not None else reg.policy.priority,
+            tenant_pending=0 if lt is None else lt.pending_points(),
+            fleet_pending=self.pending_points())
+        return self.load(tenant).batcher(kind).submit(X)
+
+    def query(self, tenant: str, X, kind: str = "u",
+              priority: Optional[int] = None):
+        """Blocking convenience: submit, flush, return the rows.  With no
+        chaos active the result is bit-identical to the same call on a
+        direct engine over the same artifact."""
+        handle = self.submit(tenant, X, kind=kind, priority=priority)
+        self._loaded[tenant].batcher(kind).flush()
+        return handle.result()
+
+    def poll(self) -> bool:
+        """Deadline sweep over every live tenant's batchers (hosts call
+        this from their event loop).  Returns whether anything flushed."""
+        return any([lt.poll() for lt in list(self._loaded.values())])
+
+    def flush(self, tenant: Optional[str] = None) -> None:
+        """Flush pending batches — one tenant's, or every live tenant's.
+        An unknown tenant raises ``KeyError`` like every sibling method
+        (a misspelled name must not masquerade as a successful flush);
+        a registered-but-unloaded tenant has nothing pending and no-ops."""
+        if tenant is None:
+            targets = list(self._loaded.values())
+        else:
+            self._reg(tenant)
+            lt = self._loaded.get(tenant)
+            targets = [lt] if lt is not None else []
+        for lt in targets:
+            lt.flush()
+
+    def pending_points(self) -> int:
+        return sum(lt.pending_points() for lt in self._loaded.values())
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        """Cache tallies + per-tenant load state and batcher stats."""
+        return {
+            "max_loaded": self.max_loaded,
+            "hits": self._hits, "misses": self._misses,
+            "evictions": self._evictions,
+            "tenants": {
+                t: {"loaded": t in self._loaded,
+                    **({"kinds": self._loaded[t].stats(),
+                        "quarantined":
+                            self._loaded[t].engine.quarantined_buckets(),
+                        "warm": self._loaded[t].warm}
+                       if t in self._loaded else {})}
+                for t in self._registered},
+        }
+
+    def autoscale_signals(self) -> dict:
+        """The scale-up/down inputs an operator loop polls: per-tenant
+        queue depth and latency percentiles, plus fleet-level cache
+        pressure (a high eviction rate with a full cache is the 'add a
+        replica / raise max_loaded' signal; all-zero queue depths with
+        idle tenants is the scale-down one)."""
+        tenants = {}
+        for t, lt in self._loaded.items():
+            agg = lt.stats()
+            lat = [s["latency_s"] for s in agg.values()
+                   if s.get("latency_s", {}).get("p99") is not None]
+            tenants[t] = {
+                "queue_depth": lt.pending_points(),
+                "qps": sum(s["qps"] or 0.0 for s in agg.values()),
+                "latency_p99_s": max((p["p99"] for p in lat),
+                                     default=None),
+                "breaker": None if lt.breaker is None else lt.breaker.state,
+            }
+        total = self._hits + self._misses
+        return {
+            "loaded": len(self._loaded), "max_loaded": self.max_loaded,
+            "cache_hit_rate": (self._hits / total) if total else None,
+            "evictions": self._evictions,
+            "pending_points": self.pending_points(),
+            "tenants": tenants,
+        }

@@ -1,0 +1,283 @@
+"""AOT warm start: kill the fresh-replica cold-start tax.
+
+A fresh serving replica otherwise pays a jit storm before its first
+answer: every (query kind, bucket) rung of the engine's pad-to-bucket
+ladder traces + XLA-compiles on first touch, which on CPU costs hundreds
+of milliseconds per program and through a TPU tunnel costs minutes.  The
+fleet answer is to move ALL of that to ``FleetRouter.load()`` time:
+
+* **artifact side** — :func:`export_fleet_artifact` embeds a warm-start
+  block in the surrogate artifact: the ladder spec (min/max bucket + the
+  query kinds to prewarm) plus one serialized compiled program per
+  (kind, bucket) rung via ``jax.export`` where the backend supports it.
+  The blobs ride the checkpoint payload (checksummed, crash-safe — see
+  ``save_checkpoint(extra_files=)``), and because an exported residual
+  program embeds the residual computation, an AOT artifact serves
+  residual queries with **no** ``f_model`` re-attached at all.
+* **replica side** — :func:`warm_start` installs the deserialized
+  programs into the engine (:meth:`InferenceEngine.install_aot`) and
+  drives one dummy query through every ladder rung, so every first-touch
+  — AOT materialization or jit compile — happens during load.  The first
+  REAL query compiles zero programs (assertable via the engine's
+  per-bucket compile counters, which is exactly how ``bench.py --fleet``
+  proves it).
+
+Fallback ladder, best to worst, degrading — never failing — the load:
+AOT program (backend matches, blob deserializes) → persistent-compile-
+cache-served jit compile (``utils.enable_compilation_cache`` — which
+keeps the PR-5 default of OFF on the CPU backend unless explicitly
+opted in) → plain jit compile at load time.  Every rung lands in one of
+the three; a corrupt blob (chaos ``warmstart_fail_n``, or a real torn
+file caught by the artifact checksum) costs that rung its AOT entry,
+nothing more.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..resilience.chaos import active_chaos
+from ..telemetry import default_registry, log_event
+from ..utils import enable_compilation_cache
+
+#: artifact-relative directory the serialized programs live in
+AOT_SUBDIR = "aot"
+#: version of the warm-start meta block (independent of the artifact
+#: schema version: the block is optional and self-describing)
+WARMSTART_FORMAT = 1
+
+DEFAULT_KINDS = ("u", "residual")
+
+
+def _blob_relpath(spec: str, bucket: int) -> str:
+    return os.path.join(AOT_SUBDIR,
+                        f"{spec.replace(':', '-')}_{int(bucket)}.bin")
+
+
+def _params_shapes(params):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        params)
+
+
+def export_fleet_artifact(surrogate, path: str, *, min_bucket: int = 256,
+                          max_bucket: int = 4096,
+                          kinds: Sequence[str] = DEFAULT_KINDS,
+                          aot: bool = True) -> dict:
+    """Save ``surrogate`` under ``path`` with a warm-start block: the
+    ladder spec, and (with ``aot=True``) one ``jax.export``-serialized
+    compiled program per (kind, bucket) rung of the ladder.
+
+    ``kinds`` are engine query-kind specs (``"u"``, ``"residual"``,
+    ``"d:<var>[:<order>[:<component>]]"``).  A kind the surrogate cannot
+    evaluate (``"residual"`` with no ``f_model``) raises — exporting a
+    warm-start promise the replica cannot keep would be worse.  A rung
+    whose program fails to export is skipped with a logged warning (the
+    replica jit-compiles that rung at load time instead); the export
+    never fails the save over it.
+
+    Returns the warm-start meta block that was embedded."""
+    # a throwaway engine supplies kind parsing + the exact per-bucket
+    # program factories the live replica will run — exporting anything
+    # else would break the fleet's bit-identity contract
+    engine = surrogate.engine(min_bucket=min_bucket, max_bucket=max_bucket)
+    specs = [engine.spec_for(engine.kind_key(k)) for k in kinds]
+    if "residual" in specs and surrogate.point_residual is None:
+        raise ValueError(
+            "cannot export a residual warm start: this surrogate has no "
+            "f_model attached (drop 'residual' from kinds=, or export "
+            "from a compiled solver)")
+
+    block = {"format": WARMSTART_FORMAT, "min_bucket": int(min_bucket),
+             "max_bucket": int(max_bucket), "kinds": specs,
+             "backend": jax.default_backend(), "aot": {}}
+    files: dict = {}
+    if aot:
+        from jax import export as jax_export
+        p_shapes = _params_shapes(surrogate.params)
+        for spec in specs:
+            fn = engine.make_batched(spec)()
+            per_kind: dict = {}
+            for bucket in engine.bucket_sizes:
+                x_shape = jax.ShapeDtypeStruct(
+                    (bucket, surrogate.ndim), np.float32)
+                try:
+                    exp = jax_export.export(jax.jit(fn))(p_shapes, x_shape)
+                    blob = exp.serialize()
+                except Exception as e:
+                    log_event("warmstart",
+                              f"AOT export failed for kind={spec} "
+                              f"bucket={bucket} ({type(e).__name__}: {e}); "
+                              "replica will jit this rung at load",
+                              level="warning", verbose=False, kind_label=spec,
+                              bucket=bucket,
+                              error=f"{type(e).__name__}: {e}")
+                    continue
+                rel = _blob_relpath(spec, bucket)
+                files[rel] = blob
+                per_kind[str(bucket)] = rel
+            if per_kind:
+                block["aot"][spec] = per_kind
+    surrogate.save(path, extra_meta={"warmstart": block},
+                   extra_files=files)
+    log_event("warmstart",
+              f"exported fleet artifact {path}: {len(files)} AOT "
+              f"program(s) over kinds={specs}, "
+              f"buckets={list(engine.bucket_sizes)}", verbose=False,
+              path=str(path), programs=len(files), kinds=specs)
+    return block
+
+
+def warm_start(engine, *, kinds: Optional[Sequence[str]] = None,
+               tenant: Optional[str] = None, registry=None,
+               max_drive_bucket: Optional[int] = None) -> dict:
+    """Prewarm ``engine`` so its first real query compiles nothing.
+
+    Reads the warm-start block from the engine's surrogate artifact meta
+    (when the surrogate was :meth:`~tensordiffeq_tpu.serving.Surrogate.load`-ed
+    from an artifact that carries one): installs every AOT program whose
+    backend matches, then drives one dummy query through every ladder
+    rung so each first-touch happens NOW.  Without a block (a pre-fleet
+    v1 artifact, or an ``aot=False`` export) the same dummy-drive runs
+    over ``kinds`` (default: ``u``, plus ``residual`` when evaluable)
+    through the jit path — after wiring the persistent compile cache
+    (:func:`~tensordiffeq_tpu.utils.enable_compilation_cache`, which
+    keeps the CPU-off default), so on TPU repeated replica starts hit
+    the disk cache.
+
+    Never raises for a degradable reason: a corrupt blob, a backend
+    mismatch, or a rung that fails to compile costs that rung its best
+    tier, and the load continues.  Returns
+    ``{"aot": n, "jit": n, "failed": n, "skipped": [...], "wall_s": s}``.
+    """
+    registry = registry if registry is not None else default_registry()
+    sur = engine.surrogate
+    block = (sur.artifact_meta or {}).get("warmstart")
+    t0 = time.monotonic()
+
+    # fallback tier 2: the persistent compile cache (no-op on CPU by
+    # default — the PR-5 correctness stance — but primes TPU replicas)
+    cache_dir = enable_compilation_cache()
+
+    # the artifact block's own kinds win when present: the artifact knows
+    # what it carries (an explicit kinds= that DROPPED a block kind would
+    # skip installing AOT programs a no-f_model replica depends on);
+    # kinds= is the fallback for block-less (v1 / aot=False) artifacts
+    if block:
+        kinds = block["kinds"]
+    elif kinds is None:
+        kinds = list(DEFAULT_KINDS)
+
+    # drive ladder cap: the warm promise is the ARTIFACT's ladder, not
+    # the policy engine's — a default-policy engine tops out at 2^20 and
+    # driving a million-point residual dummy query (13 rungs x kinds of
+    # compiles) would turn load() into the very storm warm start exists
+    # to kill.  Without a block, cap at the rung the tenant's coalescing
+    # policy actually produces (max_drive_bucket = the batcher's
+    # max_batch); rungs past the cap still compile lazily on first real
+    # demand, which is the pre-fleet behavior for shapes that rare.
+    cap = engine.bucket_sizes[-1]
+    if block:
+        cap = min(cap, int(block["max_bucket"]))
+    elif max_drive_bucket is not None:
+        cap = min(cap, engine.bucket_for(int(max_drive_bucket)))
+    aot_index = (block or {}).get("aot", {})
+    backend_ok = (block or {}).get("backend") == jax.default_backend()
+    if block and block.get("aot") and not backend_ok:
+        log_event("warmstart",
+                  f"AOT programs were exported for backend "
+                  f"{(block or {}).get('backend')!r} but this replica "
+                  f"runs {jax.default_backend()!r}; jit-prewarming "
+                  "instead", level="warning", verbose=False,
+                  tenant=tenant)
+
+    n_aot = n_jit = n_failed = 0
+    skipped: list = []
+    for spec in kinds:
+        key = engine.kind_key(spec)
+        spec = engine.spec_for(key)
+        blobs = aot_index.get(spec, {}) if backend_ok else {}
+        # install every rung's AOT program BEFORE the first drive: the
+        # residual kind with no f_model is only evaluable through them
+        installed = set()
+        for bucket in engine.bucket_sizes:
+            if bucket > cap:
+                continue
+            rel = blobs.get(str(bucket))
+            if rel is None or sur.artifact_dir is None:
+                continue
+            try:
+                chaos = active_chaos()
+                if chaos is not None:
+                    chaos.on_warmstart(spec, bucket)
+                with open(os.path.join(sur.artifact_dir, rel), "rb") as fh:
+                    blob = fh.read()
+                from jax import export as jax_export
+                exp = jax_export.deserialize(bytearray(blob))
+                engine.install_aot(
+                    spec, bucket,
+                    lambda params, X, _e=exp: _e.call(params, X))
+                installed.add(bucket)
+            except Exception as e:  # ChaosFault included — degrade, don't die
+                n_failed += 1
+                registry.counter("fleet.warmstart.aot_failed",
+                                 **({"tenant": tenant} if tenant else {})
+                                 ).inc()
+                log_event("warmstart",
+                          f"AOT program kind={spec} bucket={bucket} "
+                          f"unusable ({type(e).__name__}: {e}); rung "
+                          "falls back to jit", level="warning",
+                          verbose=False, tenant=tenant, kind_label=spec,
+                          bucket=bucket, error=f"{type(e).__name__}: {e}")
+        if spec == "residual" and sur.point_residual is None \
+                and not installed:
+            skipped.append(spec)  # nothing can evaluate it on this replica
+            continue
+        op = engine.op_for(spec)
+        dead = set(engine.quarantine_snapshot())
+        for bucket in engine.bucket_sizes:
+            if bucket > cap:
+                continue
+            if (spec, bucket) in dead:
+                continue  # eviction memory: never resurrect a dead rung
+            try:
+                op(np.zeros((bucket, sur.ndim), np.float32))
+            except Exception as e:
+                n_failed += 1
+                log_event("warmstart",
+                          f"prewarm drive failed for kind={spec} "
+                          f"bucket={bucket} ({type(e).__name__}: {e})",
+                          level="warning", verbose=False, tenant=tenant,
+                          kind_label=spec, bucket=bucket,
+                          error=f"{type(e).__name__}: {e}")
+                continue
+            if bucket in installed and engine.has_aot(spec, bucket):
+                n_aot += 1
+            else:
+                if bucket in installed:
+                    # installed but dropped at first use (the engine fell
+                    # back to jit mid-drive): the AOT tier did NOT pay
+                    n_failed += 1
+                n_jit += 1
+    wall = time.monotonic() - t0
+    labels = {"tenant": tenant} if tenant else {}
+    registry.counter("fleet.warmstart.programs", mode="aot",
+                     **labels).inc(n_aot)
+    registry.counter("fleet.warmstart.programs", mode="jit",
+                     **labels).inc(n_jit)
+    registry.histogram("fleet.warmstart.wall_s", **labels).observe(wall)
+    out = {"aot": n_aot, "jit": n_jit, "failed": n_failed,
+           "skipped": skipped, "compile_cache_dir": cache_dir,
+           "wall_s": wall}
+    log_event("warmstart",
+              f"warm start{f' tenant={tenant}' if tenant else ''}: "
+              f"{n_aot} AOT + {n_jit} jit program(s) in {wall:.3f}s"
+              + (f", {n_failed} degraded" if n_failed else ""),
+              verbose=False, tenant=tenant, **{k: v for k, v in out.items()
+                                               if k != "skipped"})
+    return out

@@ -48,6 +48,13 @@ Faults and where they fire:
 * ``compile_fail_buckets`` — first-touch compiles of these engine bucket
   sizes raise: drives the per-bucket quarantine path in
   :class:`~tensordiffeq_tpu.serving.InferenceEngine`.
+* ``fleet_evict_nth`` — the Nth fleet-router cache access force-evicts
+  the LRU tenant first: simulates memory-pressure eviction, driving the
+  evict-and-reload path (jit ladders dropped, quarantine memory kept) in
+  :class:`~tensordiffeq_tpu.fleet.FleetRouter`.
+* ``warmstart_fail_n`` — the first ``n`` AOT program loads during a fleet
+  warm start raise (a corrupt/incompatible serialized program): the warm
+  start must degrade to jit prewarm for those rungs, never fail the load.
 """
 
 from __future__ import annotations
@@ -94,7 +101,9 @@ class Chaos:
                  device_error_repeats: int = 1,
                  torn_checkpoint_nth: Optional[int] = None,
                  serving_fail_n: int = 0, serving_fail_rate: float = 0.0,
-                 compile_fail_buckets: Sequence[int] = ()):
+                 compile_fail_buckets: Sequence[int] = (),
+                 fleet_evict_nth: Optional[int] = None,
+                 warmstart_fail_n: int = 0):
         if not 0.0 <= float(serving_fail_rate) <= 1.0:
             raise ValueError(
                 f"serving_fail_rate must be in [0, 1], got {serving_fail_rate}")
@@ -109,13 +118,18 @@ class Chaos:
         self.serving_fail_n = int(serving_fail_n)
         self.serving_fail_rate = float(serving_fail_rate)
         self.compile_fail_buckets = tuple(int(b) for b in compile_fail_buckets)
+        self.fleet_evict_nth = fleet_evict_nth
+        self.warmstart_fail_n = int(warmstart_fail_n)
         self._rng = np.random.RandomState(self.seed)
         # fire bookkeeping (all monotonic counters, exposed for tests/report)
         self.fired: dict[str, int] = {"nan": 0, "preempt": 0,
                                       "device_error": 0, "torn_checkpoint": 0,
-                                      "serving": 0, "compile": 0}
+                                      "serving": 0, "compile": 0,
+                                      "fleet_evict": 0, "warmstart": 0}
         self._serving_ops = 0
         self._checkpoints = 0
+        self._fleet_accesses = 0
+        self._warmstart_loads = 0
         # epoch triggers fire once per *crossing*: a fired trigger stays
         # quiet until the observed boundary epoch goes backwards (a
         # rollback/resume leg re-entered), then re-arms if budget remains
@@ -156,7 +170,9 @@ class Chaos:
                              ("device_error_repeats", 1),
                              ("torn_checkpoint_nth", None),
                              ("serving_fail_n", 0),
-                             ("serving_fail_rate", 0.0)):
+                             ("serving_fail_rate", 0.0),
+                             ("fleet_evict_nth", None),
+                             ("warmstart_fail_n", 0)):
             v = getattr(self, key)
             if v != default:
                 parts.append(f"{key}={v:g}" if isinstance(v, float)
@@ -275,6 +291,39 @@ class Chaos:
             self.fired["compile"] += 1
             raise ChaosFault(
                 f"injected compile failure for bucket {bucket} (kind={kind})")
+
+    def on_fleet_access(self, evictable: bool = True) -> bool:
+        """Fleet-router cache-access hook: returns True when this access
+        should force-evict the LRU tenant first (simulated memory
+        pressure; drives evict-and-reload).  Counts every access but
+        fires on the first EVICTABLE one at-or-past the threshold — an
+        access with an empty cache cannot evict, so the one-shot fault
+        waits instead of burning (same at-or-past idiom as the epoch
+        triggers)."""
+        if self.fleet_evict_nth is None or self.fired["fleet_evict"]:
+            return False
+        self._fleet_accesses += 1
+        if self._fleet_accesses >= int(self.fleet_evict_nth) and evictable:
+            self.fired["fleet_evict"] += 1
+            log_event("chaos", "injected fleet cache eviction (access "
+                      f"#{self._fleet_accesses})", level="warning",
+                      verbose=False, fault="fleet_evict",
+                      access=self._fleet_accesses)
+            return True
+        return False
+
+    def on_warmstart(self, kind, bucket: int):
+        """Fleet warm-start AOT-load hook: fail the first
+        ``warmstart_fail_n`` program loads (corrupt serialized program —
+        the warm start must fall back to jit prewarm for that rung)."""
+        if not self.warmstart_fail_n:
+            return
+        self._warmstart_loads += 1
+        if self._warmstart_loads <= self.warmstart_fail_n:
+            self.fired["warmstart"] += 1
+            raise ChaosFault(
+                f"injected corrupt AOT program for kind={kind} "
+                f"bucket={bucket} (load #{self._warmstart_loads})")
 
     # ------------------------------------------------------------------ #
     def __enter__(self) -> "Chaos":

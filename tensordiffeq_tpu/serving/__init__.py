@@ -19,4 +19,5 @@ latency-percentile reporting).
 from .batcher import (PendingQuery, RequestBatcher,  # noqa: F401
                       RequestTimeout)
 from .engine import EngineDegraded, InferenceEngine  # noqa: F401
-from .surrogate import Surrogate  # noqa: F401
+from .surrogate import (ARTIFACT_VERSION,  # noqa: F401
+                        ArtifactVersionMismatch, Surrogate)

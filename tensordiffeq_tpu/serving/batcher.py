@@ -322,6 +322,23 @@ class RequestBatcher:
                 self._metrics.counter("serving.batcher.retried_ok").inc()
             return out
 
+    def fail_pending(self, exc: Exception) -> int:
+        """Fail-and-clear every still-pending request with ``exc``
+        (counted ``failed``).  The fleet router calls this at eviction:
+        a batch that cannot execute right now (breaker open, cool-down
+        running) must not strand its waiters behind an engine that is
+        about to be dropped — they get a structured failure immediately
+        instead of spinning out their deadline against a corpse."""
+        batch, self._pending = self._pending, []
+        self._pending_pts = 0
+        self._metrics.gauge("serving.batcher.queue_depth").set(0)
+        for _x, handle, _t in batch:
+            handle._fail(exc)
+        if batch:
+            self._n_failed += len(batch)
+            self._metrics.counter("serving.batcher.failed").inc(len(batch))
+        return len(batch)
+
     def flush(self) -> int:
         """Evaluate every pending query as one merged device batch and
         deliver results to the handles.  Returns the number of requests

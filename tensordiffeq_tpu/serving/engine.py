@@ -112,6 +112,7 @@ class InferenceEngine:
         self._jitted: dict = {}      # kind -> jitted callable(params, X)
         self._cache_keys: set = set()  # (kind, bucket) shapes ever compiled
         self._quarantined: set = set()  # (kind, bucket) that failed compile
+        self._aot: dict = {}  # (kind, bucket) -> AOT callable(params, X)
         self._metrics = registry if registry is not None else default_registry()
 
     # ------------------------------------------------------------------ #
@@ -144,6 +145,133 @@ class InferenceEngine:
             klabel = kind if isinstance(kind, str) else ":".join(map(str, kind))
             out.setdefault(klabel, []).append(bucket)
         return out
+
+    def quarantine_snapshot(self) -> list:
+        """JSON-able ``[(kind_spec, bucket), ...]`` of quarantined rungs —
+        the fleet router's eviction memory: an engine rebuilt after an LRU
+        eviction re-applies this via :meth:`restore_quarantine` so a dead
+        rung is never resurrected as healthy by the reload."""
+        return sorted((self.spec_for(kind), int(b))
+                      for kind, b in self._quarantined)
+
+    def restore_quarantine(self, items) -> None:
+        """Re-apply a :meth:`quarantine_snapshot` (see there)."""
+        for spec, bucket in items:
+            self._quarantined.add((self.kind_key(spec), int(bucket)))
+
+    # ------------------------------------------------------------------ #
+    # query-kind specs: the string form of the engine's internal kind keys
+    # ("u" / "residual" / "d:<var>:<order>:<component>") — what artifact
+    # warm-start blocks and the fleet router's per-kind batchers speak
+    # ------------------------------------------------------------------ #
+    def kind_key(self, spec: str):
+        """Internal kind key for a query-kind spec string: ``"u"``,
+        ``"residual"``, or ``"d:<var>:<order>:<component>"`` (var by name
+        or index; order/component default to 1/0)."""
+        if spec in ("u", "residual"):
+            return spec
+        if isinstance(spec, str) and spec.startswith("d:"):
+            parts = spec.split(":")
+            var = parts[1]
+            idx = (int(var) if var.lstrip("-").isdigit()
+                   else self.surrogate.varnames.index(var))
+            if not 0 <= idx < self.surrogate.ndim:
+                raise ValueError(f"derivative spec {spec!r}: coordinate "
+                                 f"index {idx} out of range")
+            order = int(parts[2]) if len(parts) > 2 else 1
+            comp = int(parts[3]) if len(parts) > 3 else 0
+            return ("d", idx, order, comp)
+        raise ValueError(
+            f"unknown query-kind spec {spec!r} (expected 'u', 'residual', "
+            "or 'd:<var>[:<order>[:<component>]]')")
+
+    def spec_for(self, key) -> str:
+        """Inverse of :meth:`kind_key`."""
+        if isinstance(key, str):
+            return key
+        _, idx, order, comp = key
+        return f"d:{idx}:{order}:{comp}"
+
+    def op_for(self, spec: str):
+        """The batched query callable ``X -> result`` for a kind spec —
+        what a per-kind :class:`~tensordiffeq_tpu.serving.RequestBatcher`
+        wraps."""
+        key = self.kind_key(spec)
+        if key == "u":
+            return self.u
+        if key == "residual":
+            return self.residual
+        _, idx, order, comp = key
+        return lambda X: self.derivative(X, idx, order=order,
+                                         component=comp)
+
+    def make_batched(self, spec: str):
+        """The jit-able ``(params, X) -> out`` program factory for a kind
+        spec — the exact program :meth:`u`/:meth:`derivative`/
+        :meth:`residual` compile per bucket, exposed so the fleet AOT
+        export serializes the SAME computation the live engine runs
+        (bit-identity depends on it)."""
+        return self._make_fn(self.kind_key(spec))
+
+    def _make_fn(self, key):
+        sur = self.surrogate
+        if key == "u":
+            apply_fn = sur.apply_fn
+            return lambda: apply_fn
+        if key == "residual":
+            point_res = sur.point_residual
+            if point_res is None:
+                raise ValueError(
+                    "this surrogate has no f_model attached; pass f_model= "
+                    "to Surrogate.load (or export from a compiled solver) "
+                    "to enable residual queries")
+
+            def make_res():
+                def batched(params, Xb):
+                    u = make_ufn(sur.apply_fn, params, sur.varnames,
+                                 sur.n_out)
+                    return vmap_residual(point_res, u, sur.ndim)(Xb)
+                return batched
+
+            return make_res
+        _, idx, order, component = key
+        if not 0 <= component < sur.n_out:
+            # validate eagerly: the scalar-output fast path below never
+            # consults UFn.__getitem__, which would otherwise catch this
+            raise ValueError(f"component {component} out of range for an "
+                             f"n_out={sur.n_out} surrogate")
+
+        def make_d():
+            def batched(params, Xb):
+                u = make_ufn(sur.apply_fn, params, sur.varnames, sur.n_out)
+                dfn = d(u if sur.n_out == 1 else u[component], idx, order)
+                return jax.vmap(
+                    lambda pt: dfn(*(pt[i] for i in range(sur.ndim))))(Xb)
+            return batched
+
+        return make_d
+
+    # ------------------------------------------------------------------ #
+    def install_aot(self, spec: str, bucket: int, fn) -> None:
+        """Install an ahead-of-time compiled program ``(params, X) -> out``
+        for one (kind, bucket) rung — the fleet warm-start path's
+        ``jax.export``-deserialized executables land here.  The rung's
+        first touch then runs the installed program instead of tracing +
+        jit-compiling; a program that fails on first use is dropped and
+        the rung falls back to the jit path (degraded warm start, never a
+        dead engine)."""
+        bucket = int(bucket)
+        if bucket not in self._buckets:
+            raise ValueError(f"bucket {bucket} is not on this engine's "
+                             f"ladder {self._buckets}")
+        self._aot[(self.kind_key(spec), bucket)] = fn
+
+    def has_aot(self, spec: str, bucket: int) -> bool:
+        """Is an installed AOT program still live for this rung?  (False
+        after a first-use failure dropped it back to the jit path — the
+        warm-start accounting asks, so its aot/jit tallies report the
+        tier that actually paid.)"""
+        return (self.kind_key(spec), int(bucket)) in self._aot
 
     # ------------------------------------------------------------------ #
     def _jit_for(self, kind, make_fn: Callable) -> Callable:
@@ -197,12 +325,50 @@ class InferenceEngine:
                   else jax.device_put(Xp, self._sharding))
             key = (kind, bucket)
             first_touch = key not in self._cache_keys
+            used_aot = False
             try:
                 if first_touch:
                     chaos = active_chaos()
                     if chaos is not None:
                         chaos.on_bucket_compile(kind, bucket)
-                out = self._jit_for(kind, make_fn)(self.surrogate.params, Xd)
+                aot = self._aot.get(key)
+                if aot is not None:
+                    try:
+                        out = aot(self.surrogate.params, Xd)
+                        used_aot = True
+                    except Exception as e:
+                        # corrupt/incompatible AOT program: drop it and
+                        # fall back to the jit path on the SAME rung —
+                        # a bad warm start degrades, it never kills a
+                        # rung the engine could compile itself
+                        del self._aot[key]
+                        klabel = kind if isinstance(kind, str) \
+                            else ":".join(map(str, kind))
+                        self._metrics.counter("serving.engine.aot_failed",
+                                              kind=klabel,
+                                              bucket=bucket).inc()
+                        log_event("serving",
+                                  f"AOT program kind={klabel} "
+                                  f"bucket={bucket} failed "
+                                  f"({type(e).__name__}: {e}); falling "
+                                  "back to jit", level="warning",
+                                  verbose=False, kind_label=klabel,
+                                  bucket=bucket,
+                                  error=f"{type(e).__name__}: {e}")
+                        out = self._jit_for(kind, make_fn)(
+                            self.surrogate.params, Xd)
+                        if not first_touch:
+                            # a proven AOT rung died mid-service and a
+                            # REAL compile just happened at request time
+                            # — the compile counter (the zero-request-
+                            # time-compiles proof) must see it; the
+                            # first-touch case is counted below
+                            self._metrics.counter(
+                                "serving.engine.compiles",
+                                kind=klabel, bucket=bucket).inc()
+                else:
+                    out = self._jit_for(kind, make_fn)(
+                        self.surrogate.params, Xd)
             except Exception as e:
                 if not first_touch:
                     raise
@@ -211,16 +377,20 @@ class InferenceEngine:
             break
         if first_touch:
             # first touch of this ladder rung: a real XLA compile happened
+            # (jit path), or an installed AOT executable materialized
             self._cache_keys.add(key)
             klabel = kind if isinstance(kind, str) \
                 else ":".join(map(str, kind))
-            self._metrics.counter("serving.engine.compiles",
-                                  kind=klabel, bucket=bucket).inc()
+            self._metrics.counter(
+                "serving.engine.aot_loads" if used_aot
+                else "serving.engine.compiles",
+                kind=klabel, bucket=bucket).inc()
             log_event("serving",
-                      f"compiled kind={klabel} bucket={bucket} "
+                      f"{'loaded AOT program' if used_aot else 'compiled'} "
+                      f"kind={klabel} bucket={bucket} "
                       f"({len(self._cache_keys)} programs cached)",
                       verbose=False, kind_label=klabel, bucket=bucket,
-                      programs=len(self._cache_keys))
+                      aot=used_aot, programs=len(self._cache_keys))
         self._metrics.counter("serving.engine.points").inc(int(n))
         self._metrics.histogram("serving.engine.pad_waste").observe(
             (bucket - n) / bucket)
@@ -249,8 +419,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------ #
     def u(self, X) -> np.ndarray:
         """Network evaluation ``u(X) -> [N, n_out]``."""
-        apply_fn = self.surrogate.apply_fn
-        return self._query("u", lambda: apply_fn, X)
+        return self._query("u", self._make_fn("u"), X)
 
     def derivative(self, X, var: Union[str, int], order: int = 1,
                    component: int = 0) -> np.ndarray:
@@ -259,41 +428,34 @@ class InferenceEngine:
         ``u_xx = derivative(X, "x", 2)``.  Returns ``[N]``."""
         sur = self.surrogate
         idx = var if isinstance(var, int) else sur.varnames.index(var)
-        if not 0 <= component < sur.n_out:
-            # validate eagerly: the scalar-output fast path below never
-            # consults UFn.__getitem__, which would otherwise catch this
-            raise ValueError(f"component {component} out of range for an "
-                             f"n_out={sur.n_out} surrogate")
-
-        def make():
-            def batched(params, Xb):
-                u = make_ufn(sur.apply_fn, params, sur.varnames, sur.n_out)
-                dfn = d(u if sur.n_out == 1 else u[component], idx, order)
-                return jax.vmap(
-                    lambda pt: dfn(*(pt[i] for i in range(sur.ndim))))(Xb)
-            return batched
-
-        return self._query(("d", idx, int(order), int(component)), make, X)
+        key = ("d", idx, int(order), int(component))
+        return self._query(key, self._make_fn(key), X)
 
     def residual(self, X):
         """PDE residual ``f(X) -> [N]`` (tuple of ``[N]`` for systems),
         via the generic per-point autodiff engine — the referee every
-        training engine is cross-checked against."""
-        sur = self.surrogate
-        point_res = sur.point_residual
-        if point_res is None:
-            raise ValueError(
-                "this surrogate has no f_model attached; pass f_model= to "
-                "Surrogate.load (or export from a compiled solver) to "
-                "enable residual queries")
-
-        def make():
-            def batched(params, Xb):
-                u = make_ufn(sur.apply_fn, params, sur.varnames, sur.n_out)
-                return vmap_residual(point_res, u, sur.ndim)(Xb)
-            return batched
-
-        return self._query("residual", make, X)
+        training engine is cross-checked against.  With AOT residual
+        programs installed (fleet warm start) the query also works with NO
+        ``f_model`` attached: the exported program embeds the residual
+        computation, which is exactly what makes a fleet replica
+        deployable from the artifact alone."""
+        if self.surrogate.point_residual is not None:
+            return self._query("residual", self._make_fn("residual"), X)
+        if any(k == "residual" for (k, _b) in self._aot):
+            # no f_model, but AOT programs exist: rungs they cover serve;
+            # a rung without one fails its first touch and quarantines
+            # (reroute/EngineDegraded), same as any unusable rung
+            def make_unavailable():
+                def batched(params, Xb):
+                    raise ValueError(
+                        "residual rung has no AOT program and this "
+                        "surrogate has no f_model attached")
+                return batched
+            return self._query("residual", make_unavailable, X)
+        raise ValueError(
+            "this surrogate has no f_model attached; pass f_model= to "
+            "Surrogate.load (or export from a compiled solver) to "
+            "enable residual queries")
 
     def predict(self, X):
         """``(u, f)`` pair mirroring ``CollocationSolverND.predict`` (``f``

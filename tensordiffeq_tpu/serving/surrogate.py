@@ -48,11 +48,34 @@ from ..checkpoint import (resolve_checkpoint_dir, restore_checkpoint,
 from ..networks import init_params, net_from_metadata, net_metadata
 
 _FORMAT = 1
+# Artifact SCHEMA version (distinct from _FORMAT, which predates it and is
+# pinned by tests as the original marker field).  v1 = the pre-fleet PR-2
+# artifact (no version field — absent reads as 1); v2 adds the optional
+# fleet warm-start block (ladder spec + AOT program files).  Every version
+# <= ARTIFACT_VERSION stays loadable; a NEWER version fails loudly with
+# :class:`ArtifactVersionMismatch` instead of mis-restoring fields this
+# build has never heard of.
+ARTIFACT_VERSION = 2
 # which f_model signature the artifact's residual expects:
 #   forward    f_model(u, *coords)            (CollocationSolverND)
 #   discovery  f_model(u, var, *coords)       (DiscoveryModel; var = the
 #              learned coefficients, persisted in the artifact meta)
 _CONTRACTS = ("forward", "discovery")
+
+
+class ArtifactVersionMismatch(ValueError):
+    """The artifact's schema version is newer than this build supports —
+    loading would silently drop (or mis-read) fields the producer relied
+    on.  Upgrade the serving build, or re-export the artifact."""
+
+    def __init__(self, path: str, found: int, supported: int):
+        self.path = path
+        self.found = int(found)
+        self.supported = int(supported)
+        super().__init__(
+            f"{path} is a v{found} surrogate artifact but this build "
+            f"supports up to v{supported}; upgrade tensordiffeq_tpu or "
+            "re-export the artifact with this version")
 
 
 class Surrogate:
@@ -83,6 +106,12 @@ class Surrogate:
         self.f_model = f_model
         self.layer_sizes = list(getattr(net, "layer_sizes",
                                         (self.ndim, self.n_out)))
+        # populated by load(): the artifact's meta dict and the resolved
+        # on-disk directory — what the fleet warm-start path reads its
+        # ladder spec and AOT program files from.  Empty/None for
+        # surrogates built straight from a solver.
+        self.artifact_meta: dict = {}
+        self.artifact_dir: Optional[str] = None
 
     # ------------------------------------------------------------------ #
     @property
@@ -144,19 +173,30 @@ class Surrogate:
         return InferenceEngine(self, **kwargs)
 
     # ------------------------------------------------------------------ #
-    def save(self, path: str) -> None:
+    def save(self, path: str, extra_meta: Optional[dict] = None,
+             extra_files: Optional[dict] = None) -> None:
         """Persist under directory ``path`` via the checkpoint backend
         (orbax primary, flax fallback, crash-safe swap).  The state pytree
         is ``{"params": ...}`` only — by construction there is no optimizer
-        state, λ, or collocation set to leak into the artifact."""
+        state, λ, or collocation set to leak into the artifact.
+
+        ``extra_meta`` merges additional JSON-serialisable fields into the
+        artifact meta and ``extra_files`` maps artifact-relative paths to
+        raw bytes saved (and checksummed) alongside the state — the fleet
+        layer uses both to embed its warm-start block
+        (:func:`tensordiffeq_tpu.fleet.export_fleet_artifact`)."""
         meta = net_metadata(self.net, self.layer_sizes, self.n_out)
         meta.update(surrogate_format=_FORMAT,
+                    artifact_version=ARTIFACT_VERSION,
                     varnames=list(self.varnames),
                     contract=self.contract)
         if self.coefficients is not None:
             meta["coefficients"] = [np.asarray(c).tolist()
                                     for c in self.coefficients]
-        save_checkpoint(path, {"params": self.params}, meta)
+        if extra_meta:
+            meta.update(extra_meta)
+        save_checkpoint(path, {"params": self.params}, meta,
+                        extra_files=extra_files)
 
     @classmethod
     def load(cls, path: str, f_model: Optional[Callable] = None,
@@ -171,14 +211,20 @@ class Surrogate:
         was exported from a ``compile(..., network=...)`` solver whose net
         is not one of :data:`~tensordiffeq_tpu.networks.REBUILDABLE_NETS`;
         it must be built with the same config the training run used."""
-        with open(os.path.join(resolve_checkpoint_dir(path),
-                               "tdq_meta.json")) as fh:
+        artifact_dir = resolve_checkpoint_dir(path)
+        with open(os.path.join(artifact_dir, "tdq_meta.json")) as fh:
             meta = json.load(fh)["meta"]
         if "surrogate_format" not in meta:
             raise ValueError(
                 f"{path} is not a surrogate artifact (it has no "
                 "surrogate_format field — a full training checkpoint "
                 "belongs to solver.restore_checkpoint)")
+        # schema gate BEFORE touching any other field: pre-version artifacts
+        # (PR 2..5 era) backfill to v1 and stay loadable; anything newer
+        # than this build fails loudly instead of mis-restoring
+        version = int(meta.get("artifact_version", 1))
+        if version > ARTIFACT_VERSION:
+            raise ArtifactVersionMismatch(path, version, ARTIFACT_VERSION)
         if net is None:
             try:
                 net = net_from_metadata(meta)
@@ -190,7 +236,10 @@ class Surrogate:
         template = {"params": init_params(net, int(meta["layer_sizes"][0]),
                                           jax.random.PRNGKey(0))}
         state, _ = restore_checkpoint(path, template)
-        return cls(net, state["params"], meta["varnames"],
-                   n_out=int(meta["n_out"]), f_model=f_model,
-                   coefficients=meta.get("coefficients"),
-                   contract=meta.get("contract", "forward"))
+        sur = cls(net, state["params"], meta["varnames"],
+                  n_out=int(meta["n_out"]), f_model=f_model,
+                  coefficients=meta.get("coefficients"),
+                  contract=meta.get("contract", "forward"))
+        sur.artifact_meta = meta
+        sur.artifact_dir = artifact_dir
+        return sur

@@ -124,6 +124,12 @@ def summarize(run_dir: str) -> dict:
         "retries": of_kind("retry"),
         "breaker_transitions": [e for e in of_kind("breaker")
                                 if e.get("to_state")],
+        # fleet trail (PR 6): loads/evictions, shed traffic, warm starts
+        "fleet_events": of_kind("fleet"),
+        "admission_rejections": [e for e in of_kind("admission")
+                                 if e.get("reason")],
+        "warmstarts": [e for e in of_kind("warmstart")
+                       if e.get("wall_s") is not None],
     }
 
 
@@ -229,6 +235,35 @@ def report(run_dir: str, width: int = 72) -> str:
         lines.append(f"breaker {_fmt(bt.get('name'))}: "
                      f"{bt.get('from_state')} -> {bt.get('to_state')} "
                      f"({_fmt(bt.get('reason'))})")
+
+    # -- fleet trail: loads/evictions, shed traffic, warm starts -------- #
+    if s["fleet_events"]:
+        loads = [e for e in s["fleet_events"] if e.get("event") == "load"]
+        evicts = [e for e in s["fleet_events"] if e.get("event") == "evict"]
+        lines.append(
+            f"FLEET: {len(loads)} tenant load(s), {len(evicts)} "
+            f"eviction(s)"
+            + (f"; tenants loaded: "
+               + ", ".join(sorted({str(e.get('tenant')) for e in loads}))
+               if loads else ""))
+    for ws in s["warmstarts"]:
+        if ws.get("tenant") is None and ws.get("aot") is None:
+            continue
+        lines.append(
+            f"WARM START{(' ' + str(ws['tenant'])) if ws.get('tenant') else ''}: "
+            f"{_fmt(ws.get('aot'))} AOT + {_fmt(ws.get('jit'))} jit "
+            f"program(s) in {_fmt(ws.get('wall_s'))}s"
+            + (f" ({ws['failed']} degraded)" if ws.get("failed") else ""))
+    if s["admission_rejections"]:
+        by_reason: dict = {}
+        for e in s["admission_rejections"]:
+            k = (str(e.get("tenant")), str(e.get("reason")))
+            by_reason[k] = by_reason.get(k, 0) + 1
+        lines.append(
+            f"ADMISSION: {len(s['admission_rejections'])} request(s) shed "
+            "at the front door: " + ", ".join(
+                f"{t}/{r} x{n}"
+                for (t, r), n in sorted(by_reason.items())))
 
     # -- λ health ------------------------------------------------------- #
     if s["lambda_last"] is not None:

@@ -21,7 +21,16 @@ SAME_PATH = {
 TOP_LEVEL = ["CollocationSolverND", "DiscoveryModel", "DomainND",
              "IC", "dirichletBC", "FunctionDirichletBC",
              "FunctionNeumannBC", "periodicBC", "grad",
-             "find_L2_error", "MSE", "g_MSE"]
+             "find_L2_error", "MSE", "g_MSE",
+             # fleet/serving deployment surface (PR 6)
+             "FleetRouter", "TenantPolicy", "AdmissionController",
+             "AdmissionRejected", "ArtifactVersionMismatch"]
+
+# the fleet package's own public surface (docs/api.md Fleet section)
+FLEET = ["FleetRouter", "TenantPolicy", "LoadedTenant",
+         "AdmissionController", "AdmissionRejected", "PRIORITIES",
+         "export_fleet_artifact", "warm_start", "AOT_SUBDIR",
+         "DEFAULT_KINDS"]
 
 
 def test_migration_same_path_symbols_resolve():
@@ -35,3 +44,9 @@ def test_migration_same_path_symbols_resolve():
 def test_top_level_reexports():
     missing = [n for n in TOP_LEVEL if not hasattr(tdq, n)]
     assert not missing, f"top-level re-exports missing: {missing}"
+
+
+def test_fleet_surface():
+    missing = [f"tdq.fleet.{n}" for n in FLEET
+               if not hasattr(tdq.fleet, n)]
+    assert not missing, f"fleet surface missing: {missing}"

@@ -358,6 +358,60 @@ def test_serving_partial_carries_real_headline():
     assert "note" in p
 
 
+def test_fleet_mode_registered():
+    """--fleet is a first-class mode: distinct cache artifact, a budget
+    entry, and the --mode spelling maps onto it."""
+    bench = _load_bench()
+    assert bench.mode_name(["--fleet"]) == "fleet"
+    assert bench.tpu_cache_file(["--fleet"]).endswith(
+        "BENCH_TPU_fleet.json")
+
+
+def test_fleet_partial_carries_real_headline():
+    """The warm-start-phase partial streamed by --fleet must publish the
+    measured speedup as a real headline with the fallback disclosed in
+    the metric string — never the final payload's null QPS value."""
+    bench = _load_bench()
+    p = bench.fleet_partial(
+        {"metric": "multi-tenant fleet serving QPS (2 tenants, mixed "
+                   "u/residual)",
+         "value": None, "unit": "queries/sec/chip",
+         "warm_start": {"speedup": 12.5, "request_time_compiles": 0}})
+    assert p["value"] == 12.5 and "cold / warm" in p["unit"]
+    assert "incomplete" in p["metric"] and "QPS" not in p["metric"].split(
+        "(")[0]
+    assert "note" in p
+
+
+def test_fleet_json_contract_on_cpu_fallback(tmp_path):
+    """`python bench.py --mode fleet` must emit ONE valid JSON line with
+    the fleet contract — and the contract IS the acceptance bar: on CPU
+    the warm-started tenant's first query compiles zero programs at
+    request time and beats the cold first query by >= 5x."""
+    env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="420",
+               JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
+               BENCH_TPU_CACHE_DIR=str(tmp_path))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "fleet"],
+        capture_output=True, text=True, timeout=500, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # supervisor: exactly one line
+    p = json.loads(lines[0])
+    assert p["unit"] == "queries/sec/chip"
+    assert isinstance(p["value"], (int, float)) and p["value"] > 0
+    assert p["tenants_total"] >= 2 and len(p["per_tenant"]) >= 2
+    ws = p["warm_start"]
+    assert ws["request_time_compiles"] == 0  # nothing compiled at request
+    assert ws["speedup"] >= 5.0  # the >=5x CPU acceptance bar
+    assert ws["aot_programs"] > 0
+    assert ws["cold_first_query_s"] > ws["warm_first_query_s"] > 0
+    assert p["cache"]["misses"] >= 2  # every tenant loaded once
+    assert p["autoscale"]["loaded"] == p["tenants_total"]
+    assert p["backend"] == "cpu"  # this env: the fallback really ran
+
+
 def test_serving_json_contract_on_cpu_fallback(tmp_path):
     """`python bench.py --mode serving` must emit ONE valid JSON line with
     the serving contract (queries/sec/chip headline, grid rates, bounded

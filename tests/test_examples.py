@@ -70,6 +70,19 @@ def test_ac_sa_periodic_net_example_runs():
 
 
 @pytest.mark.slow
+def test_ac_fleet_example_runs():
+    """The PR-6 acceptance demo: two trained surrogates exported as AOT
+    fleet artifacts, fleet-served in a genuinely fresh subprocess — the
+    script itself asserts zero request-time compiles after warm start,
+    structured rate-limit shedding, and bit-identity against direct
+    engines (tenant b's residual served with no f_model at all).  Marked
+    slow for tier-1 wall budget: the same paths run fast in
+    tests/test_fleet.py; this adds the fresh-process round-trip and the
+    narrated report on top."""
+    run_example("ac_fleet.py")
+
+
+@pytest.mark.slow
 def test_ac_resilient_example_runs():
     """The PR-5 acceptance demo: ONE supervised run survives a chaos NaN
     divergence and a chaos preemption, the serving leg heals injected

@@ -1,0 +1,519 @@
+"""Fleet subsystem (tensordiffeq_tpu.fleet): LRU artifact cache, admission
+control, AOT warm start, per-tenant resilience — and the contracts the
+ISSUE pins: chaos-off fleet answers bit-identical to direct engine
+queries, zero request-time compiles after a warm start, and a
+quarantined (kind, bucket) never resurrected as healthy by
+evict-and-reload.
+
+All CPU, all tier-1 fast.  The two fleet artifacts are built once per
+module (session-ish fixture) — each carries AOT programs for the u and
+residual kinds over a tiny 64..128 ladder."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
+                              dirichletBC, grad)
+from tensordiffeq_tpu import fleet, telemetry
+from tensordiffeq_tpu.fleet import (AdmissionController, AdmissionRejected,
+                                    FleetRouter, TenantPolicy)
+from tensordiffeq_tpu.resilience import Chaos, CircuitOpenError
+from tensordiffeq_tpu.serving import ArtifactVersionMismatch, Surrogate
+
+MIN_B, MAX_B = 64, 128  # two-rung ladder: fast compiles, real routing
+
+
+def make_solver(seed=0):
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(128, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
+           dirichletBC(domain, val=0.0, var="x", target="upper"),
+           dirichletBC(domain, val=0.0, var="x", target="lower")]
+
+    def f_model(u, x, t):
+        u_x, u_t = grad(u, "x"), grad(u, "t")
+        return u_t(x, t) + u(x, t) * u_x(x, t) - 0.01 * grad(u_x, "x")(x, t)
+
+    s = CollocationSolverND(verbose=False, seed=seed)
+    s.compile([2, 8, 8, 1], f_model, domain, bcs, fused=False)
+    return s, f_model
+
+
+def query_points(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.uniform(-1, 1, n),
+                     rng.uniform(0, 1, n)], -1).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Two AOT fleet artifacts (tenants of the same PDE family, different
+    seeds) + the f_model they were trained with."""
+    root = tmp_path_factory.mktemp("fleet_artifacts")
+    out = {}
+    for name, seed in (("a", 0), ("b", 1)):
+        s, f_model = make_solver(seed=seed)
+        art = str(root / name)
+        block = fleet.export_fleet_artifact(
+            s.export_surrogate(), art, min_bucket=MIN_B, max_bucket=MAX_B)
+        out[name] = art
+        out["f_model"] = f_model
+        out["block"] = block
+    return out
+
+
+def small_policy(**kw):
+    return TenantPolicy(min_bucket=MIN_B, max_bucket=MAX_B, max_batch=256,
+                        max_latency_s=0.005, **kw)
+
+
+def engine_compiles():
+    """Process-wide jit first-touch tally (delta-assert against it: the
+    shared registry accumulates across tests)."""
+    return sum(v for k, v in
+               telemetry.default_registry().as_dict()["counters"].items()
+               if k.startswith("serving.engine.compiles"))
+
+
+# --------------------------------------------------------------------------- #
+# artifact schema version (satellite 1)
+# --------------------------------------------------------------------------- #
+def _meta_path(art):
+    from tensordiffeq_tpu.checkpoint import resolve_checkpoint_dir
+    return os.path.join(resolve_checkpoint_dir(art), "tdq_meta.json")
+
+
+def test_artifact_carries_schema_version_and_warmstart_block(artifacts):
+    with open(_meta_path(artifacts["a"])) as fh:
+        meta = json.load(fh)["meta"]
+    assert meta["artifact_version"] == 2
+    ws = meta["warmstart"]
+    assert ws["kinds"] == ["u", "residual"]
+    assert ws["min_bucket"] == MIN_B and ws["max_bucket"] == MAX_B
+    # one serialized program per (kind, bucket) rung, on disk, checksummed
+    d = os.path.dirname(_meta_path(artifacts["a"]))
+    for kind, per_bucket in ws["aot"].items():
+        assert sorted(per_bucket, key=int) == [str(MIN_B), str(MAX_B)]
+        for rel in per_bucket.values():
+            assert os.path.getsize(os.path.join(d, rel)) > 0
+
+
+def _copy_with_meta(src, dest, mutate):
+    """Clone an artifact and rewrite its meta dict (the meta file is
+    outside the checksum domain, so edits do not trip validation)."""
+    import shutil
+    shutil.copytree(src, dest)
+    p = _meta_path(dest)
+    with open(p) as fh:
+        info = json.load(fh)
+    mutate(info["meta"])
+    with open(p, "w") as fh:
+        json.dump(info, fh)
+    return dest
+
+
+def test_newer_artifact_version_fails_loudly(artifacts, tmp_path):
+    art = _copy_with_meta(
+        artifacts["a"], str(tmp_path / "future"),
+        lambda m: m.update(artifact_version=99))
+    with pytest.raises(ArtifactVersionMismatch, match="v99"):
+        Surrogate.load(art)
+
+
+def test_version_absent_backfills_to_v1_and_loads(artifacts, tmp_path):
+    def strip(m):  # simulate a pre-fleet artifact
+        del m["artifact_version"]
+        del m["warmstart"]
+
+    art = _copy_with_meta(artifacts["a"], str(tmp_path / "v1era"), strip)
+    sur = Surrogate.load(art, f_model=artifacts["f_model"])
+    assert sur.artifact_meta.get("warmstart") is None
+    assert sur.engine(min_bucket=MIN_B).u(query_points(8)).shape == (8, 1)
+
+
+def test_corrupt_aot_blob_fails_artifact_checksum(artifacts, tmp_path):
+    """AOT blobs ride the checkpoint payload: a torn blob fails the whole
+    generation's checksum instead of silently serving a corrupt program."""
+    import shutil
+
+    from tensordiffeq_tpu.checkpoint import CheckpointCorrupted
+    art = str(tmp_path / "torn")
+    shutil.copytree(artifacts["a"], art)
+    d = os.path.dirname(_meta_path(art))
+    ws = json.load(open(_meta_path(art)))["meta"]["warmstart"]
+    victim = os.path.join(d, ws["aot"]["u"][str(MIN_B)])
+    with open(victim, "r+b") as fh:
+        fh.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(CheckpointCorrupted):
+        Surrogate.load(art)
+
+
+# --------------------------------------------------------------------------- #
+# LRU artifact cache
+# --------------------------------------------------------------------------- #
+def test_lru_load_hit_evict(artifacts):
+    router = FleetRouter(max_loaded=2)
+    for t in ("a", "b", "c"):
+        # cold policy: this test pins LRU mechanics, not warmth
+        router.register(t, artifacts[t if t in artifacts else "a"],
+                        f_model=artifacts["f_model"],
+                        policy=small_policy(warm_start=False))
+    router.load("a")
+    router.load("b")
+    assert router.loaded() == ("a", "b")
+    router.load("a")  # refresh: "b" becomes LRU
+    assert router.loaded() == ("b", "a")
+    router.load("c")  # evicts "b", not the freshly-touched "a"
+    assert router.loaded() == ("a", "c")
+    s = router.stats()
+    assert s["hits"] == 1 and s["misses"] == 3 and s["evictions"] == 1
+    assert not s["tenants"]["b"]["loaded"]
+
+
+def test_unknown_tenant_and_bad_config():
+    router = FleetRouter(max_loaded=1)
+    with pytest.raises(KeyError, match="not registered"):
+        router.load("ghost")
+    with pytest.raises(ValueError, match="max_loaded"):
+        FleetRouter(max_loaded=0)
+
+
+# --------------------------------------------------------------------------- #
+# warm start: zero request-time compiles, bit-identity (acceptance bar)
+# --------------------------------------------------------------------------- #
+def test_warm_start_zero_request_time_compiles(artifacts):
+    router = FleetRouter(max_loaded=2)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    lt = router.load("a")
+    # every rung of both kinds came in as an AOT program at load time
+    assert lt.warm["aot"] == 4 and lt.warm["jit"] == 0
+    before = engine_compiles()
+    X = query_points(100, seed=3)
+    u = router.query("a", X)
+    f = router.query("a", X, kind="residual")
+    assert engine_compiles() == before, \
+        "warm-started tenant compiled at request time"
+    assert u.shape == (100, 1) and f.shape == (100,)
+
+
+def test_fleet_queries_bit_identical_to_direct_engine(artifacts):
+    """The chaos-off contract: a fleet-served answer (AOT programs, batcher
+    coalescing, admission in front) is bit-identical to the same query on
+    a direct jit engine over the same artifact."""
+    router = FleetRouter(max_loaded=2)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    direct = Surrogate.load(
+        artifacts["a"], f_model=artifacts["f_model"]).engine(
+            min_bucket=MIN_B, max_bucket=MAX_B)
+    for n in (17, 64, 100):  # pad, exact-bucket, and chunk-crossing sizes
+        X = query_points(n, seed=n)
+        assert np.array_equal(router.query("a", X), direct.u(X))
+        assert np.array_equal(router.query("a", X, kind="residual"),
+                              direct.residual(X))
+
+
+def test_aot_residual_serves_without_f_model(artifacts):
+    """The AOT payoff: the exported residual program embeds the residual
+    computation, so a replica needs NO f_model source at all.  The
+    policy's warm_kinds deliberately names only "u": the artifact
+    block's own kinds must win (dropping a block kind would skip
+    installing exactly the programs a no-f_model replica depends on)."""
+    router = FleetRouter(max_loaded=1)
+    router.register("b", artifacts["b"],  # no f_model
+                    policy=small_policy(warm_kinds=["u"]))
+    X = query_points(50, seed=5)
+    f = router.query("b", X, kind="residual")
+    direct = Surrogate.load(
+        artifacts["b"], f_model=artifacts["f_model"]).engine(
+            min_bucket=MIN_B, max_bucket=MAX_B)
+    assert np.array_equal(f, direct.residual(X))
+
+
+def test_v1_artifact_warm_starts_via_jit_prewarm(tmp_path, artifacts):
+    """A pre-fleet artifact (no warm-start block) still loads and
+    prewarms — through the jit fallback tier — and still answers its
+    first query without request-time compiles."""
+    def strip(m):  # a v1-era artifact: no version field, no AOT block
+        del m["artifact_version"]
+        del m["warmstart"]
+
+    art = _copy_with_meta(artifacts["a"], str(tmp_path / "plain"), strip)
+    router = FleetRouter(max_loaded=1)
+    router.register("p", art, f_model=artifacts["f_model"],
+                    policy=small_policy())
+    lt = router.load("p")
+    assert lt.warm["aot"] == 0 and lt.warm["jit"] == 4
+    before = engine_compiles()
+    router.query("p", query_points(20))
+    assert engine_compiles() == before
+
+
+# --------------------------------------------------------------------------- #
+# quarantine x eviction (satellite 3): no resurrection on reload
+# --------------------------------------------------------------------------- #
+def test_quarantined_bucket_survives_evict_and_reload(artifacts):
+    router = FleetRouter(max_loaded=1)
+    router.register("q", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    with Chaos(compile_fail_buckets=[MIN_B]):
+        lt = router.load("q")  # warm drive first-touches every rung
+    assert lt.engine.quarantined_buckets() == {
+        "u": [MIN_B], "residual": [MIN_B]}
+    # small queries reroute to the healthy 128 rung and still serve
+    X = query_points(10, seed=7)
+    u_before = router.query("q", X)
+
+    router.evict("q")
+    assert router.loaded() == ()
+    lt2 = router.load("q")  # NO chaos active now
+    # the dead rungs came back quarantined — not resurrected as healthy
+    assert lt2.engine.quarantined_buckets() == {
+        "u": [MIN_B], "residual": [MIN_B]}
+    assert lt2.engine.bucket_sizes[0] == MIN_B  # ladder unchanged
+    # and the reloaded tenant's answers still match (rerouted, same math)
+    assert np.array_equal(router.query("q", X), u_before)
+    # warm start did not drive (or count) the quarantined rungs
+    assert lt2.warm["aot"] + lt2.warm["jit"] == 2
+
+
+# --------------------------------------------------------------------------- #
+# admission control (front door)
+# --------------------------------------------------------------------------- #
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_admission_rate_limit_token_bucket():
+    clock = FakeClock()
+    ac = AdmissionController(clock=clock)
+    ac.configure("t", rate_qps=2.0, burst=2.0)
+    ac.admit("t", 1)
+    ac.admit("t", 1)  # burst exhausted
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.admit("t", 1)
+    assert ei.value.reason == "rate_limit" and ei.value.retry_after_s > 0
+    clock.t += 0.5  # one token refills at 2/s
+    ac.admit("t", 1)
+    # a bucket that can never hold one whole token would lock the tenant
+    # out forever while hinting a retry that cannot come true
+    with pytest.raises(ValueError, match="burst"):
+        ac.configure("x", rate_qps=5.0, burst=0.5)
+    with pytest.raises(ValueError, match="rate_qps"):
+        ac.configure("x", rate_qps=0.0)
+
+
+def test_admission_tenant_queue_bound():
+    ac = AdmissionController()
+    ac.configure("t", max_queue_points=100)
+    ac.admit("t", 50, tenant_pending=40)
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.admit("t", 50, tenant_pending=60)
+    assert ei.value.reason == "tenant_queue_full"
+
+
+def test_admission_priority_ordered_shedding():
+    ac = AdmissionController(max_pending_points=1000, shed_watermark=0.5)
+    # past the watermark: priority 0 shed, 1 and 2 admitted
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.admit("t", 1, priority=0, fleet_pending=600)
+    assert ei.value.reason == "load_shed"
+    ac.admit("t", 1, priority=1, fleet_pending=600)
+    # at saturation: only priority 2 rides the reserved headroom
+    for p in (0, 1):
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("t", 1, priority=p, fleet_pending=1000)
+        assert ei.value.reason == "fleet_saturated"
+    ac.admit("t", 1, priority=2, fleet_pending=1000)
+    with pytest.raises(ValueError, match="priority"):
+        ac.admit("t", 1, priority=7)
+
+
+def test_router_admission_before_queue_and_load(artifacts):
+    """A shed request must not load the tenant, let alone queue points —
+    admission is the FIRST gate."""
+    router = FleetRouter(max_loaded=1)
+    router.register("z", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy(max_queue_points=0))
+    with pytest.raises(AdmissionRejected) as ei:
+        router.submit("z", query_points(4))
+    assert ei.value.reason == "tenant_queue_full"
+    assert router.loaded() == ()  # rejection never triggered the load
+
+
+# --------------------------------------------------------------------------- #
+# per-tenant resilience isolation + fleet chaos faults
+# --------------------------------------------------------------------------- #
+def test_per_tenant_breaker_isolation(artifacts):
+    """Tenant a's dying op opens tenant a's breaker; tenant b keeps
+    serving through its own."""
+    router = FleetRouter(max_loaded=2)
+    pol = small_policy(breaker_failure_threshold=1)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=pol)
+    router.register("b", artifacts["b"], f_model=artifacts["f_model"],
+                    policy=pol)
+    lt_a, lt_b = router.load("a"), router.load("b")
+    with Chaos(serving_fail_n=1):
+        h = router.submit("a", query_points(4))
+        with pytest.raises(Exception):
+            lt_a.batcher("u").flush()  # injected fault -> breaker opens
+        assert h.done and lt_a.breaker.state == "open"
+        # tenant b is untouched: its own breaker, its own health
+        assert router.query("b", query_points(4)).shape == (4, 1)
+        assert lt_b.breaker.state == "closed"
+    # while a's circuit is open, new submits to a fast-fail structurally
+    h2 = router.submit("a", query_points(2))
+    assert h2.done
+    with pytest.raises(CircuitOpenError):
+        h2.result()
+
+
+def test_eviction_fails_fast_waiters_behind_open_breaker(artifacts):
+    """A batch that cannot execute (breaker open) must not strand its
+    waiters when the tenant is evicted: flush() is a no-op against an
+    open circuit, so evict() fail-fasts the queue with a structured
+    TenantEvicted instead of leaving handles spinning out a 30s
+    deadline against a dropped engine."""
+    from tensordiffeq_tpu.fleet import TenantEvicted
+    router = FleetRouter(max_loaded=1)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy(breaker_failure_threshold=1,
+                                        breaker_reset_timeout_s=3600.0))
+    lt = router.load("a")
+    # queued on the residual kind BEFORE the circuit opens...
+    h_r = router.submit("a", query_points(2), kind="residual")
+    with Chaos(serving_fail_n=1):
+        h_u = router.submit("a", query_points(3))
+        with pytest.raises(Exception):
+            lt.batcher("u").flush()  # ...u's failure opens the shared
+    assert lt.breaker.state == "open"  # tenant breaker
+    assert h_u.done and not h_r.done
+    router.evict("a")
+    assert h_r.done
+    with pytest.raises(TenantEvicted, match="evicted"):
+        h_r.result()
+    assert router.loaded() == ()
+
+
+def test_admission_rate_token_not_burned_by_other_rejections():
+    """A request shed for a non-rate reason must not consume rate
+    budget — otherwise overload retries against a full queue
+    double-penalize the tenant once the queue drains."""
+    clock = FakeClock()
+    ac = AdmissionController(clock=clock)
+    ac.configure("t", rate_qps=100.0, burst=2.0, max_queue_points=10)
+    for _ in range(5):  # five queue-full rejections...
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("t", 5, tenant_pending=10)
+        assert ei.value.reason == "tenant_queue_full"
+    ac.admit("t", 5, tenant_pending=0)  # ...burned zero tokens
+    ac.admit("t", 5, tenant_pending=0)  # full burst still available
+
+
+def test_warm_drive_capped_at_artifact_ladder(artifacts):
+    """The warm promise is the ARTIFACT's ladder: a policy engine with a
+    much taller ladder must not turn load() into a compile storm over
+    rungs the artifact never exported (they stay lazy)."""
+    router = FleetRouter(max_loaded=1)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=TenantPolicy(min_bucket=MIN_B, max_bucket=1024,
+                                        max_batch=256,
+                                        max_latency_s=0.005))
+    lt = router.load("a")
+    # 2 kinds x the 2 block rungs — never the 256/512/1024 policy rungs
+    assert lt.warm["aot"] == 4 and lt.warm["jit"] == 0
+
+
+def test_router_flush_unknown_tenant_raises(artifacts):
+    router = FleetRouter(max_loaded=1)
+    router.register("a", artifacts["a"], policy=small_policy())
+    with pytest.raises(KeyError, match="not registered"):
+        router.flush("tennant-typo")
+    router.flush("a")  # registered but unloaded: nothing pending, no-op
+    router.flush()     # fleet-wide: fine with nothing loaded
+
+
+def test_chaos_fleet_eviction_fault(artifacts):
+    router = FleetRouter(max_loaded=2)
+    router.register("a", artifacts["a"],
+                    policy=small_policy(warm_start=False))
+    router.register("b", artifacts["b"],
+                    policy=small_policy(warm_start=False))
+    with Chaos(fleet_evict_nth=1) as chaos:
+        router.load("a")  # access 1 at the threshold — but the cache is
+        # empty: the one-shot fault must WAIT, not burn with no eviction
+        assert chaos.fired["fleet_evict"] == 0
+        router.load("b")  # first EVICTABLE access: fires, evicts "a"
+        assert chaos.fired["fleet_evict"] == 1
+    assert router.loaded() == ("b",)
+    assert router.stats()["evictions"] == 1
+
+
+def test_chaos_warmstart_corruption_degrades_to_jit(artifacts):
+    router = FleetRouter(max_loaded=1)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    with Chaos(warmstart_fail_n=2) as chaos:
+        lt = router.load("a")
+    assert chaos.fired["warmstart"] == 2
+    # two rungs lost their AOT tier and fell back to jit — AT LOAD TIME
+    assert lt.warm["aot"] == 2 and lt.warm["jit"] == 2
+    assert lt.warm["failed"] == 2
+    before = engine_compiles()
+    router.query("a", query_points(30))
+    assert engine_compiles() == before  # still zero at request time
+
+
+def test_chaos_spec_roundtrip_fleet_keys():
+    c = Chaos.from_spec("fleet_evict_nth=2,warmstart_fail_n=3,seed=5")
+    assert c.fleet_evict_nth == 2 and c.warmstart_fail_n == 3
+    assert Chaos.from_spec(c.spec()).spec() == c.spec()
+
+
+# --------------------------------------------------------------------------- #
+# telemetry: autoscaling signals + report narration
+# --------------------------------------------------------------------------- #
+def test_autoscale_signals_and_stats(artifacts):
+    router = FleetRouter(max_loaded=2)
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    router.query("a", query_points(12))
+    sig = router.autoscale_signals()
+    assert sig["loaded"] == 1 and sig["max_loaded"] == 2
+    assert sig["tenants"]["a"]["queue_depth"] == 0
+    assert sig["tenants"]["a"]["qps"] is not None
+    assert 0.0 <= sig["cache_hit_rate"] <= 1.0
+    s = router.stats()["tenants"]["a"]
+    assert s["loaded"] and s["kinds"]["u"]["requests"] == 1
+    assert s["warm"]["aot"] == 4
+
+
+def test_report_narrates_fleet_trail(artifacts, tmp_path):
+    run_dir = str(tmp_path / "run")
+    with telemetry.RunLogger(run_dir, config={}):
+        router = FleetRouter(max_loaded=1)
+        router.register("a", artifacts["a"], policy=small_policy(
+            rate_qps=1.0, burst=1.0))
+        router.register("b", artifacts["b"], policy=small_policy())
+        router.query("a", query_points(4))
+        router.load("b")  # evicts a
+        with pytest.raises(AdmissionRejected):
+            router.submit("a", query_points(2))  # rate limit: shed
+    text = telemetry.report(run_dir)
+    assert "FLEET: 2 tenant load(s), 1 eviction(s)" in text
+    assert "WARM START" in text and "AOT" in text
+    assert "ADMISSION: 1 request(s) shed" in text and "a/rate_limit" in text
+    s = telemetry.summarize(run_dir)
+    assert len(s["fleet_events"]) >= 3  # 2 loads + 1 evict
+    assert len(s["warmstarts"]) == 2

@@ -29,8 +29,8 @@ def _print_calls(path):
             and isinstance(node.func, ast.Name) and node.func.id == "print"]
 
 
-def test_no_bare_print_outside_telemetry():
-    violations = []
+def _scan():
+    violations, scanned = [], set()
     for root, _dirs, files in os.walk(PKG):
         for name in files:
             if not name.endswith(".py"):
@@ -39,9 +39,25 @@ def test_no_bare_print_outside_telemetry():
             rel = os.path.relpath(path, PKG)
             if rel.startswith(ALLOWED[0]) or rel == ALLOWED[1]:
                 continue
+            scanned.add(rel)
             for lineno in _print_calls(path):
                 violations.append(f"tensordiffeq_tpu/{rel}:{lineno}")
+    return violations, scanned
+
+
+def test_no_bare_print_outside_telemetry():
+    violations, _ = _scan()
     assert not violations, (
         "bare print() calls found (route them through telemetry.log_event "
         "so quiet runs stay quiet and events reach the JSONL sink):\n  "
         + "\n  ".join(violations))
+
+
+def test_guard_covers_serving_and_fleet():
+    """The guard's coverage is part of its contract: the serving and
+    fleet packages (operator-facing, narration-heavy) must be inside the
+    scanned set, not accidentally excluded by a future allowlist edit."""
+    _, scanned = _scan()
+    for sub in ("serving", "fleet"):
+        assert any(rel.startswith(sub + os.sep) for rel in scanned), \
+            f"{sub}/ fell out of the bare-print guard's coverage"

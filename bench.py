@@ -49,6 +49,12 @@ Modes:
   --full        train AC-SA for real (Adam + L-BFGS) with periodic L2
                 evaluation; reports wall-clock to rel-L2 <= 2.1e-2 (the
                 SA-PINN paper figure cited at reference ``models.py:37``)
+  --slo TARGET  not a measurement: evaluate the default SLO set
+                (telemetry.slo) against an existing runs/<dir> or a bench
+                payload JSON file, print one machine-readable verdict
+                line, and exit nonzero on breach — the CI gate over
+                captured evidence (the one mode exempt from the
+                always-exit-0 contract, by design)
 
 Env knobs: ``BENCH_NF`` (default 50000), ``BENCH_STEPS`` (default 100),
 ``BENCH_FAST=1`` (tiny smoke config), ``BENCH_TIMEOUT`` (per-attempt
@@ -77,26 +83,9 @@ RESERVE_S = 45
 
 EPS = 0.0001  # Allen-Cahn diffusion coefficient
 
-# Dense bf16 peak FLOP/s per chip (public figures; MFU basis).  The fp32
-# path runs below these peaks by design — quoting the bf16 basis is the
-# standard, conservative convention.
-PEAK_FLOPS = {
-    "v2": 46e12, "v3": 123e12, "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-}
-
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
-
-
-def peak_flops_for(device_kind: str):
-    dk = device_kind.lower()
-    for key, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if key in dk:
-            return val
-    return None
 
 
 # --------------------------------------------------------------------------- #
@@ -271,17 +260,15 @@ def make_sa_step(solver):
 
 
 def compiled_flops(compiled):
-    """FLOPs per step from the compiled executable's XLA cost model
-    (None if the backend doesn't expose it)."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = ca.get("flops")
-        return float(flops) if flops and flops > 0 else None
-    except Exception as e:
-        log(f"[mfu] cost_analysis unavailable: {type(e).__name__}: {e}")
-        return None
+    """FLOPs per step from the compiled executable's XLA cost model (None
+    if the backend doesn't expose it) — single-sourced in
+    :mod:`tensordiffeq_tpu.telemetry.costmodel` since PR 7; the fit- and
+    serve-time live gauges quote the same read."""
+    from tensordiffeq_tpu.telemetry import costmodel
+    flops = costmodel.compiled_flops(compiled)
+    if flops is None:
+        log("[mfu] cost_analysis unavailable for this program/backend")
+    return flops
 
 
 def _record_step_split(n_steps, dispatch_s, device_s):
@@ -313,17 +300,16 @@ def bench_telemetry_block():
 
 
 def _analytic_step_floor(n_f, widths):
-    """Lower bound on model FLOPs for one SA train step: forward + backward
-    over the collocation batch alone (``2*sum(d_i*d_{i+1})`` MACs per point
-    per pass, >= 3 forward-equivalent passes).  A compiled-step count below
-    this is physically impossible — it means XLA's cost model could not see
-    into a custom call (pallas kernels score 0, so a pallas-engine step
-    reports only its non-kernel scraps: the 2026-08-01 default capture said
-    0.48 GFLOP for a step the roofline puts at ~93 GFLOP, and quoted MFU
-    0.0004)."""
-    dims = [2, *widths, 1]
-    per_pt = 2 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
-    return 3.0 * per_pt * n_f
+    """Lower bound on model FLOPs for one SA train step (see
+    :func:`tensordiffeq_tpu.telemetry.costmodel.analytic_step_floor`, the
+    single source since PR 7).  A compiled-step count below this is
+    physically impossible — it means XLA's cost model could not see into
+    a custom call (pallas kernels score 0, so a pallas-engine step
+    reports only its non-kernel scraps: the 2026-08-01 default capture
+    said 0.48 GFLOP for a step the roofline puts at ~93 GFLOP, and
+    quoted MFU 0.0004)."""
+    from tensordiffeq_tpu.telemetry import costmodel
+    return costmodel.analytic_step_floor(n_f, [2, *widths, 1])
 
 
 def aot_compile_sa_step(solver):
@@ -387,13 +373,14 @@ def resolve_flop_basis(measured, n_f, nx, nt, widths):
     own program, and ``flops_basis`` in the payload discloses that); only
     a count below the analytic floor (= a cost model blinded by a pallas
     custom call) falls back to the generic-engine basis.  A known-truncated
-    count is never quoted: no basis -> no MFU."""
-    if measured is not None and measured >= _analytic_step_floor(n_f, widths):
-        return measured, "compiled"
-    generic, basis = generic_step_flops(n_f, nx, nt, widths)
-    if generic is not None:
-        return generic, basis
-    return None, None
+    count is never quoted: no basis -> no MFU.  The floor/substitution
+    rules are :func:`tensordiffeq_tpu.telemetry.costmodel.resolve_flop_basis`
+    (single-sourced since PR 7); this wrapper only supplies the
+    bench-built generic-engine fallback."""
+    from tensordiffeq_tpu.telemetry import costmodel
+    return costmodel.resolve_flop_basis(
+        measured, _analytic_step_floor(n_f, widths),
+        fallback=lambda: generic_step_flops(n_f, nx, nt, widths))
 
 
 def mfu_for(measured_flops, steps_per_sec, n_chips, n_f, nx, nt, widths):
@@ -401,14 +388,13 @@ def mfu_for(measured_flops, steps_per_sec, n_chips, n_f, nx, nt, widths):
     precision) so the basis/peak handling cannot drift between artifacts.
     MFU only on TPU: CPU has no meaningful peak to quote against."""
     import jax
+
+    from tensordiffeq_tpu.telemetry import costmodel
     if jax.default_backend() != "tpu":
         return measured_flops, None, None
     flops, basis = resolve_flop_basis(measured_flops, n_f, nx, nt, widths)
-    mfu = None
-    peak = peak_flops_for(jax.devices()[0].device_kind)
-    if peak and flops is not None:
-        mfu = flops * steps_per_sec / n_chips / peak
-    return flops, basis, mfu
+    peak = costmodel.peak_flops_for(jax.devices()[0].device_kind)
+    return flops, basis, costmodel.mfu(flops, steps_per_sec, n_chips, peak)
 
 
 def build_solver_fallback(n_f, nx, nt, widths, fused, tag, grad_probe=False):
@@ -1501,6 +1487,28 @@ def worker_main(args):
     print(json.dumps(payload), flush=True)
 
 
+def slo_verdict(target):
+    """``bench.py --slo`` body: the default
+    :class:`tensordiffeq_tpu.telemetry.SLOSet` verdict for ``target`` — a
+    telemetry run directory (manifest metrics + events, including the
+    step-time-regression window) or any bench payload JSON file (its
+    embedded ``telemetry.metrics`` registry snapshot).  Returns the
+    verdict dict; the caller turns ``ok`` into the exit code."""
+    from tensordiffeq_tpu.telemetry.slo import SLOSet
+    slo = SLOSet.default()
+    if os.path.isdir(target):
+        verdict = slo.evaluate_run(target)
+        source = "run_dir"
+    else:
+        payload = last_json_line(open(target).read())
+        if payload is None:
+            raise ValueError(f"no JSON payload line in {target}")
+        metrics = ((payload.get("telemetry") or {}).get("metrics")) or {}
+        verdict = slo.evaluate(metrics)
+        source = "payload"
+    return dict(verdict, target=str(target), source=source)
+
+
 def last_json_line(text):
     """Last parseable JSON-object line of a worker's stdout (bytes or str).
 
@@ -1758,6 +1766,11 @@ def main():
                                        "serving", "fleet"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
+    ap.add_argument("--slo", metavar="TARGET",
+                    help="evaluate the default SLO set against an existing "
+                         "runs/<dir> or bench payload JSON and exit nonzero "
+                         "on breach (machine-readable verdict line; a CI "
+                         "gate, not a measurement mode)")
     ap.add_argument("--chaos", metavar="SPEC",
                     help="activate deterministic fault injection for the "
                          "worker run (tensordiffeq_tpu.resilience.Chaos "
@@ -1771,6 +1784,13 @@ def main():
     args = ap.parse_args()
     if args.mode and args.mode != "default":
         setattr(args, args.mode, True)
+
+    if args.slo:
+        # CI gate over captured evidence: no probe, no worker, no cache —
+        # and deliberately NOT exit-0-always (the breach IS the signal)
+        verdict = slo_verdict(args.slo)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 3)
 
     if args.worker:
         worker_main(args)

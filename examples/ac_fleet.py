@@ -55,7 +55,8 @@ def serve(artifacts: str, quick: bool):
 
     art_a, art_b = artifacts.split(",")
     run_dir = os.path.join(tempfile.mkdtemp(), "fleet_run")
-    with telemetry.RunLogger(run_dir, config={"example": "ac_fleet"}):
+    with telemetry.RunLogger(run_dir, config={"example": "ac_fleet"}), \
+            telemetry.Tracer():
         router = fleet.FleetRouter(max_loaded=2)
         policy = fleet.TenantPolicy(min_bucket=MIN_BUCKET,
                                     max_bucket=MAX_BUCKET,
@@ -89,6 +90,31 @@ def serve(artifacts: str, quick: bool):
         assert compiles() - before == 0, \
             "warm-started tenant compiled at request time"
         print("[fleet] first query served with 0 request-time compiles")
+
+        # -- the query left a COMPLETE span tree in events.jsonl -------- #
+        spans = telemetry.tracing.read_spans(run_dir)
+        trees = telemetry.tracing.span_tree(spans)
+        [req] = [r for group in trees.values() for r in group
+                 if r["name"] == "fleet.request"]
+
+        def names(node, acc):
+            acc.add(node["name"])
+            for c in node["children"]:
+                names(c, acc)
+            return acc
+
+        got = names(req, set())
+        for expected in ("fleet.request", "fleet.submit",
+                         "fleet.admission", "fleet.load",
+                         "serving.batcher.enqueue",
+                         "serving.batcher.flush", "serving.engine.run",
+                         "serving.engine.dispatch",
+                         "serving.engine.device"):
+            assert expected in got, \
+                f"span {expected!r} missing from the request trace {got}"
+        print(f"[fleet] request trace {req['trace']}: "
+              f"{len(got)} span kinds, admission -> engine dispatch, "
+              f"{req['dur_s'] * 1e3:.1f}ms end to end")
 
         # -- mixed multi-tenant traffic --------------------------------- #
         n_req = 40 if quick else 400

@@ -33,6 +33,7 @@ import time
 from typing import Callable, Optional
 
 from ..telemetry import default_registry, log_event
+from ..telemetry.tracing import active_tracer, attach_trace
 
 #: priority levels: 0 = batch/background (shed first), 1 = interactive
 #: (default), 2 = critical (rides the reserved headroom)
@@ -46,7 +47,11 @@ class AdmissionRejected(RuntimeError):
     watermark; priority 0 traffic), or ``fleet_saturated`` (fleet at
     capacity; priority <= 1 traffic).  ``retry_after_s`` is the
     backpressure hint (0 when retrying immediately might succeed, e.g.
-    after other tenants drain)."""
+    after other tenants drain).  ``trace_id`` is stamped when a
+    :class:`~tensordiffeq_tpu.telemetry.Tracer` is active — the id
+    resolves the rejection's span in the run log."""
+
+    trace_id = None
 
     def __init__(self, tenant: str, reason: str,
                  retry_after_s: float = 0.0, detail: str = ""):
@@ -162,15 +167,32 @@ class AdmissionController:
                   + (f" ({detail})" if detail else ""),
                   level="warning", verbose=False, tenant=tenant,
                   reason=reason, retry_after_s=retry_after_s)
-        raise AdmissionRejected(tenant, reason, retry_after_s, detail)
+        raise attach_trace(
+            AdmissionRejected(tenant, reason, retry_after_s, detail))
 
     def admit(self, tenant: str, n_points: int,
               priority: Optional[int] = None, *,
               tenant_pending: int = 0, fleet_pending: int = 0) -> None:
         """Gate one request of ``n_points`` rows.  Raises
-        :class:`AdmissionRejected` or returns None (admitted).  The
+        :class:`AdmissionRejected` or returns None (admitted); with a
+        tracer active the decision is a ``fleet.admission`` span
+        (``status=error`` on a shed, carrying the reason).  The
         router passes the live queue depths; standalone callers may
         pass their own."""
+        tr = active_tracer()  # one probe when tracing is off
+        if tr is None:
+            return self._admit(tenant, n_points, priority,
+                               tenant_pending=tenant_pending,
+                               fleet_pending=fleet_pending)
+        with tr.span("fleet.admission", tenant=str(tenant),
+                     n=int(n_points)):
+            return self._admit(tenant, n_points, priority,
+                               tenant_pending=tenant_pending,
+                               fleet_pending=fleet_pending)
+
+    def _admit(self, tenant: str, n_points: int,
+               priority: Optional[int] = None, *,
+               tenant_pending: int = 0, fleet_pending: int = 0) -> None:
         if priority is None:
             priority = self.priority_for(tenant)
         if priority not in PRIORITIES:

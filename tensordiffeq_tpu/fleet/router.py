@@ -53,6 +53,8 @@ from ..resilience.chaos import active_chaos
 from ..serving.batcher import RequestBatcher
 from ..serving.surrogate import Surrogate
 from ..telemetry import default_registry, log_event
+from ..telemetry.slo import SLOSet
+from ..telemetry.tracing import active_tracer
 from .admission import AdmissionController
 from .warmstart import warm_start
 
@@ -219,18 +221,25 @@ class FleetRouter:
         it.
       clock: time source, injectable for tests (threads through
         batchers, breakers and the admission controller built here).
+      slo: the :class:`~tensordiffeq_tpu.telemetry.SLOSet` whose verdict
+        rides in :meth:`autoscale_signals` (default: the standard set),
+        so an operator loop scales up on SLO burn, not just on queue
+        depth.  Evaluation runs only when signals are polled — the
+        default costs nothing between polls.
     """
 
     def __init__(self, max_loaded: int = 4,
                  admission: Optional[AdmissionController] = None,
                  registry=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 slo: Optional[SLOSet] = None):
         if max_loaded < 1:
             raise ValueError(f"max_loaded must be >= 1, got {max_loaded}")
         self.max_loaded = int(max_loaded)
         self._registry = (registry if registry is not None
                           else default_registry())
         self._clock = clock
+        self.slo = slo if slo is not None else SLOSet.default()
         self.admission = (admission if admission is not None
                           else AdmissionController(clock=clock,
                                                    registry=self._registry))
@@ -277,7 +286,18 @@ class FleetRouter:
         """The tenant's live instance: a cache hit refreshes its LRU slot;
         a miss evicts down to ``max_loaded - 1``, restores the artifact
         through the checksum-validated checkpoint path, re-applies the
-        tenant's quarantine memory, and warm-starts the engine."""
+        tenant's quarantine memory, and warm-starts the engine.  With a
+        tracer active the load-or-hit is a ``fleet.load`` span."""
+        tr = active_tracer()
+        if tr is None:
+            return self._load(tenant)
+        with tr.span("fleet.load", tenant=str(tenant)) as sp:
+            hits0 = self._hits
+            lt = self._load(tenant)
+            sp.set_attrs(cache=("hit" if self._hits > hits0 else "miss"))
+            return lt
+
+    def _load(self, tenant: str) -> LoadedTenant:
         reg = self._reg(tenant)
         chaos = active_chaos()
         if chaos is not None and chaos.on_fleet_access(
@@ -357,7 +377,16 @@ class FleetRouter:
         then coalesces into the tenant's per-kind batcher.  Returns the
         batcher's :class:`~tensordiffeq_tpu.serving.PendingQuery` handle;
         raises :class:`~tensordiffeq_tpu.fleet.AdmissionRejected` when
-        shed."""
+        shed.  With a tracer active the admit → load-or-queue path is a
+        ``fleet.submit`` span tree (nested under ``fleet.request`` when
+        reached through :meth:`query`)."""
+        tr = active_tracer()  # one probe on the untraced path
+        if tr is None:
+            return self._submit(tenant, X, kind, priority)
+        with tr.span("fleet.submit", tenant=str(tenant), kind=str(kind)):
+            return self._submit(tenant, X, kind, priority)
+
+    def _submit(self, tenant: str, X, kind: str, priority: Optional[int]):
         reg = self._reg(tenant)  # unknown tenants fail before admission
         n = int(np.atleast_2d(np.asarray(X)).shape[0])
         lt = self._loaded.get(tenant)
@@ -372,10 +401,19 @@ class FleetRouter:
               priority: Optional[int] = None):
         """Blocking convenience: submit, flush, return the rows.  With no
         chaos active the result is bit-identical to the same call on a
-        direct engine over the same artifact."""
-        handle = self.submit(tenant, X, kind=kind, priority=priority)
-        self._loaded[tenant].batcher(kind).flush()
-        return handle.result()
+        direct engine over the same artifact; with a tracer active the
+        whole request is one ``fleet.request`` span tree — admission →
+        load → batcher enqueue/flush → engine run → dispatch/device —
+        the end-to-end trace the run log keeps per query."""
+        tr = active_tracer()
+        if tr is None:
+            handle = self.submit(tenant, X, kind=kind, priority=priority)
+            self._loaded[tenant].batcher(kind).flush()
+            return handle.result()
+        with tr.span("fleet.request", tenant=str(tenant), kind=str(kind)):
+            handle = self.submit(tenant, X, kind=kind, priority=priority)
+            self._loaded[tenant].batcher(kind).flush()
+            return handle.result()
 
     def poll(self) -> bool:
         """Deadline sweep over every live tenant's batchers (hosts call
@@ -418,10 +456,12 @@ class FleetRouter:
 
     def autoscale_signals(self) -> dict:
         """The scale-up/down inputs an operator loop polls: per-tenant
-        queue depth and latency percentiles, plus fleet-level cache
-        pressure (a high eviction rate with a full cache is the 'add a
-        replica / raise max_loaded' signal; all-zero queue depths with
-        idle tenants is the scale-down one)."""
+        queue depth and latency percentiles, fleet-level cache pressure
+        (a high eviction rate with a full cache is the 'add a replica /
+        raise max_loaded' signal; all-zero queue depths with idle
+        tenants is the scale-down one), and the :class:`SLOSet` verdict
+        over the router's registry — scale on burn rate before the
+        breach, not after."""
         tenants = {}
         for t, lt in self._loaded.items():
             agg = lt.stats()
@@ -441,4 +481,5 @@ class FleetRouter:
             "evictions": self._evictions,
             "pending_points": self.pending_points(),
             "tenants": tenants,
+            "slo": self.slo.evaluate(self._registry),
         }

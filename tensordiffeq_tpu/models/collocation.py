@@ -767,6 +767,19 @@ class CollocationSolverND:
         tele = as_training_telemetry(telemetry)
         epochs_at_entry = len(self.losses)
         if tele is not None:
+            # the analytic FLOP floor guards the live cost model: a
+            # compiled-step count below it means XLA's cost analysis was
+            # blinded by a custom call (pallas scores zero) and must not
+            # be quoted as-is (telemetry.costmodel).  Priced on the
+            # PER-STEP batch, not N_f: a minibatched step legitimately
+            # executes batch_sz points' worth of FLOPs, and an N_f floor
+            # would discard its honest compiled count and inflate MFU.
+            from ..telemetry.costmodel import analytic_step_floor
+            n_f_total = int(self.X_f.shape[0])
+            step_points = (n_f_total if batch_sz is None
+                           else min(int(batch_sz), n_f_total))
+            tele.cost_floor = analytic_step_floor(step_points,
+                                                  self.layer_sizes)
             tele.on_fit_start(dict(
                 tf_iter=tf_iter, newton_iter=newton_iter, batch_sz=batch_sz,
                 N_f=int(self.X_f.shape[0]),

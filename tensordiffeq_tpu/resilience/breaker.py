@@ -38,7 +38,11 @@ _STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 class CircuitOpenError(RuntimeError):
     """Fast-fail raised while the breaker is open.  ``retry_after_s`` is
     the remaining cool-down — a structured backpressure hint for callers
-    (and the batcher's timeout sweep)."""
+    (and the batcher's timeout sweep).  ``trace_id`` is stamped by the
+    serving layer when a tracer is active (root-cause the rejection from
+    the run log)."""
+
+    trace_id = None
 
     def __init__(self, name: str, retry_after_s: float):
         self.breaker = name

@@ -41,14 +41,18 @@ from ..profiling import percentiles, stopwatch
 from ..resilience.breaker import CircuitOpenError
 from ..resilience.chaos import active_chaos
 from ..telemetry import default_registry, log_event
+from ..telemetry.tracing import active_tracer, attach_trace
 
 
 class RequestTimeout(RuntimeError):
     """A request's deadline expired before its batch executed.  Carries
-    ``waited_s`` — how long the request sat in the queue."""
+    ``waited_s`` — how long the request sat in the queue — and, when the
+    request was submitted under a tracer, the ``trace_id`` whose span
+    tree shows what it was waiting behind."""
 
-    def __init__(self, waited_s: float):
+    def __init__(self, waited_s: float, trace_id=None):
         self.waited_s = float(waited_s)
+        self.trace_id = trace_id
         super().__init__(
             f"request timed out after {waited_s:.3f}s without its batch "
             "executing (backend down or circuit breaker open)")
@@ -57,7 +61,8 @@ class RequestTimeout(RuntimeError):
 class PendingQuery:
     """Handle returned by :meth:`RequestBatcher.submit`."""
 
-    __slots__ = ("_batcher", "_value", "_error", "_done", "_t_submit")
+    __slots__ = ("_batcher", "_value", "_error", "_done", "_t_submit",
+                 "trace_id")
 
     def __init__(self, batcher, t_submit: float):
         self._batcher = batcher
@@ -65,6 +70,7 @@ class PendingQuery:
         self._error = None
         self._done = False
         self._t_submit = t_submit
+        self.trace_id = None  # set at submit when a tracer is active
 
     @property
     def done(self) -> bool:
@@ -191,8 +197,26 @@ class RequestBatcher:
         count reaches ``max_batch``.  While the circuit breaker is open the
         handle comes back already failed with
         :class:`~tensordiffeq_tpu.resilience.CircuitOpenError` — fast
-        structured rejection instead of queue pileup."""
+        structured rejection instead of queue pileup.
+
+        With a :class:`~tensordiffeq_tpu.telemetry.Tracer` active the
+        enqueue is a ``serving.batcher.enqueue`` span, the handle carries
+        its ``trace_id``, and structured failures (rejection, timeout)
+        carry the same id; with none active the cost is a single stack
+        probe and the served bits are identical."""
         X = np.atleast_2d(np.asarray(X, np.float32))
+        tr = active_tracer()  # ONE probe per request when tracing is off
+        if tr is None:
+            return self._submit(X)
+        with tr.span("serving.batcher.enqueue", n=int(X.shape[0])) as sp:
+            handle = self._submit(X)
+            handle.trace_id = sp.trace_id
+            if handle._error is not None:
+                sp.status = "error"
+                sp.error = f"{type(handle._error).__name__}: {handle._error}"
+            return handle
+
+    def _submit(self, X) -> PendingQuery:
         now = self._clock()
         handle = PendingQuery(self, now)
         self._n_requests += 1
@@ -200,8 +224,9 @@ class RequestBatcher:
                 and self.breaker.retry_after_s() > 0.0:
             self._n_rejected += 1
             self._metrics.counter("serving.batcher.rejected").inc()
-            handle._fail(CircuitOpenError(self.breaker.name,
-                                          self.breaker.retry_after_s()))
+            handle._fail(attach_trace(
+                CircuitOpenError(self.breaker.name,
+                                 self.breaker.retry_after_s())))
             return handle
         if self._first_submit is None:
             self._first_submit = now
@@ -243,8 +268,17 @@ class RequestBatcher:
             self._pending_pts = sum(x.shape[0] for x, _, _ in keep)
             self._metrics.gauge("serving.batcher.queue_depth").set(
                 self._pending_pts)
+            tr = active_tracer()
             for x, handle, t in expired:
-                handle._fail(RequestTimeout(now - t))
+                handle._fail(RequestTimeout(now - t,
+                                            trace_id=handle.trace_id))
+                if tr is not None and handle.trace_id is not None:
+                    # stamp the timeout into the ORIGINAL request's trace
+                    # (its enqueue span closed long ago) so the failure
+                    # is root-causable from the log by trace_id alone
+                    tr.record_span("serving.batcher.timeout", 0.0,
+                                   parent=None, trace_id=handle.trace_id,
+                                   status="error", waited_s=now - t)
             self._n_timed_out += len(expired)
             self._metrics.counter("serving.batcher.timed_out").inc(
                 len(expired))
@@ -276,7 +310,8 @@ class RequestBatcher:
                 self._n_timed_out += 1
                 self._metrics.counter("serving.batcher.timed_out").inc()
                 handle._fail(RequestTimeout(
-                    self._clock() - handle._t_submit))
+                    self._clock() - handle._t_submit,
+                    trace_id=handle.trace_id))
                 return
             waits.append(remaining)
         self._sleep(max(min(waits), 0.001))
@@ -359,6 +394,10 @@ class RequestBatcher:
         self._metrics.gauge("serving.batcher.queue_depth").set(0)
         X = np.concatenate([x for x, _, _ in batch]) if len(batch) > 1 \
             else batch[0][0]
+        tr = active_tracer()
+        span = None if tr is None else tr.open_span(
+            "serving.batcher.flush", requests=len(batch),
+            points=int(X.shape[0]))
         try:
             with stopwatch(verbose=False) as sw:
                 out = self._run_op(X)
@@ -370,7 +409,11 @@ class RequestBatcher:
                 handle._fail(e)
             self._n_failed += len(batch)
             self._metrics.counter("serving.batcher.failed").inc(len(batch))
+            if span is not None:
+                tr.close_span(span, error=e)
             raise
+        if span is not None:
+            tr.close_span(span)
         done = self._clock()
         lat_hist = self._metrics.histogram("serving.batcher.latency_s")
         offset = 0

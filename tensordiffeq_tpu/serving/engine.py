@@ -42,6 +42,8 @@ import numpy as np
 from ..ops.derivatives import d, make_ufn, vmap_residual
 from ..resilience.chaos import active_chaos
 from ..telemetry import default_registry, log_event
+from ..telemetry.costmodel import program_cost
+from ..telemetry.tracing import active_tracer
 from .surrogate import Surrogate
 
 
@@ -110,6 +112,7 @@ class InferenceEngine:
                     f"{n_dev}-device mesh")
             self._sharding = data_sharding(mesh, ndim=2)
         self._jitted: dict = {}      # kind -> jitted callable(params, X)
+        self._priced: set = set()    # kinds whose cost gauges are set
         self._cache_keys: set = set()  # (kind, bucket) shapes ever compiled
         self._quarantined: set = set()  # (kind, bucket) that failed compile
         self._aot: dict = {}  # (kind, bucket) -> AOT callable(params, X)
@@ -308,13 +311,92 @@ class InferenceEngine:
                   verbose=False, kind_label=klabel, bucket=bucket,
                   error=f"{type(exc).__name__}: {exc}")
 
+    def _price_first_touch(self, kind, bucket: int, fn, Xd) -> None:
+        """Best-effort per-program cost gauges at a KIND's first jit
+        touch: ``Lowered.cost_analysis()`` prices the program WITHOUT a
+        second XLA compile (one extra trace, small next to the compile
+        this rung is about to pay), and the gauges disclose what one
+        query point costs — the serve-time half of
+        :mod:`~tensordiffeq_tpu.telemetry.costmodel`.  Per-point cost is
+        bucket-size-invariant (every kind is pointwise along the batch
+        axis), so one rung prices the kind and the other rungs skip the
+        extra trace."""
+        if kind in self._priced:
+            return
+        self._priced.add(kind)
+        try:
+            cost = program_cost(fn.lower(self.surrogate.params, Xd))
+        except Exception:
+            return
+        klabel = kind if isinstance(kind, str) else ":".join(map(str, kind))
+        if cost["flops"] is not None:
+            self._metrics.gauge("serving.engine.flops_per_point",
+                                kind=klabel, bucket=bucket).set(
+                cost["flops"] / bucket)
+        if cost["bytes_accessed"] is not None:
+            self._metrics.gauge("serving.engine.bytes_per_point",
+                                kind=klabel, bucket=bucket).set(
+                cost["bytes_accessed"] / bucket)
+
     def _run(self, kind, make_fn: Callable, X: np.ndarray):
-        """Pad one ``<= max_bucket`` chunk to its bucket, run, trim.  A
-        first-touch (compile-time) failure quarantines that (kind, bucket)
-        rung and retries on the next larger one; a failure on an
-        already-proven rung is a runtime fault and propagates (the
-        batcher's retry/breaker layer owns transient runtime faults)."""
+        """Pad one ``<= max_bucket`` chunk to its bucket, run, trim (span-
+        traced as ``serving.engine.run`` > ``dispatch``/``device`` when a
+        tracer is active; one stack probe when not).  A first-touch
+        (compile-time) failure quarantines that (kind, bucket) rung and
+        retries on the next larger one; a failure on an already-proven
+        rung is a runtime fault and propagates (the batcher's
+        retry/breaker layer owns transient runtime faults)."""
+        tr = active_tracer()  # ONE probe on the untraced path
+        if tr is None:
+            return self._run_inner(kind, make_fn, X, None)
+        klabel = kind if isinstance(kind, str) else ":".join(map(str, kind))
+        with tr.span("serving.engine.run", kind=klabel,
+                     n=int(X.shape[0])):
+            return self._run_inner(kind, make_fn, X, tr)
+
+    def _run_inner(self, kind, make_fn: Callable, X: np.ndarray, tr):
         n = X.shape[0]
+        dispatch_span = None if tr is None else tr.open_span(
+            "serving.engine.dispatch")
+        try:
+            bucket, out, first_touch, used_aot, key = self._attempt(
+                kind, make_fn, X, n)
+        except Exception as e:
+            if dispatch_span is not None:
+                tr.close_span(dispatch_span, error=e)
+            raise
+        if dispatch_span is not None:
+            dispatch_span.set_attrs(bucket=int(bucket),
+                                    pad=int(bucket - n))
+            tr.close_span(dispatch_span)
+        if first_touch:
+            # first touch of this ladder rung: a real XLA compile happened
+            # (jit path), or an installed AOT executable materialized
+            self._cache_keys.add(key)
+            klabel = kind if isinstance(kind, str) \
+                else ":".join(map(str, kind))
+            self._metrics.counter(
+                "serving.engine.aot_loads" if used_aot
+                else "serving.engine.compiles",
+                kind=klabel, bucket=bucket).inc()
+            log_event("serving",
+                      f"{'loaded AOT program' if used_aot else 'compiled'} "
+                      f"kind={klabel} bucket={bucket} "
+                      f"({len(self._cache_keys)} programs cached)",
+                      verbose=False, kind_label=klabel, bucket=bucket,
+                      aot=used_aot, programs=len(self._cache_keys))
+        self._metrics.counter("serving.engine.points").inc(int(n))
+        self._metrics.histogram("serving.engine.pad_waste").observe(
+            (bucket - n) / bucket)
+        if tr is None:
+            return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
+        with tr.span("serving.engine.device"):
+            # the compiled call above was async-dispatched; materialising
+            # the host arrays is the device wait — same fencing read as
+            # the training chunks' block_until_ready split
+            return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
+
+    def _attempt(self, kind, make_fn: Callable, X: np.ndarray, n: int):
         while True:
             bucket = self._bucket_for_routing(kind, n)
             Xp = X if n == bucket else np.concatenate(
@@ -367,34 +449,19 @@ class InferenceEngine:
                                 "serving.engine.compiles",
                                 kind=klabel, bucket=bucket).inc()
                 else:
-                    out = self._jit_for(kind, make_fn)(
-                        self.surrogate.params, Xd)
+                    fn = self._jit_for(kind, make_fn)
+                    if first_touch:
+                        # price the rung BEFORE the call: the executed
+                        # program donates Xd, and a post-call lowering
+                        # would read a deleted buffer
+                        self._price_first_touch(kind, bucket, fn, Xd)
+                    out = fn(self.surrogate.params, Xd)
             except Exception as e:
                 if not first_touch:
                     raise
                 self._quarantine(kind, bucket, e)
                 continue
-            break
-        if first_touch:
-            # first touch of this ladder rung: a real XLA compile happened
-            # (jit path), or an installed AOT executable materialized
-            self._cache_keys.add(key)
-            klabel = kind if isinstance(kind, str) \
-                else ":".join(map(str, kind))
-            self._metrics.counter(
-                "serving.engine.aot_loads" if used_aot
-                else "serving.engine.compiles",
-                kind=klabel, bucket=bucket).inc()
-            log_event("serving",
-                      f"{'loaded AOT program' if used_aot else 'compiled'} "
-                      f"kind={klabel} bucket={bucket} "
-                      f"({len(self._cache_keys)} programs cached)",
-                      verbose=False, kind_label=klabel, bucket=bucket,
-                      aot=used_aot, programs=len(self._cache_keys))
-        self._metrics.counter("serving.engine.points").inc(int(n))
-        self._metrics.histogram("serving.engine.pad_waste").observe(
-            (bucket - n) / bucket)
-        return jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), out)
+            return bucket, out, first_touch, used_aot, key
 
     def _query(self, kind, make_fn: Callable, X):
         X = np.asarray(X, np.float32)

@@ -19,9 +19,25 @@ shared observability layer for training, serving, and bench:
   summaries, ``block_until_ready``-fenced step-time breakdown, checkpoint
   events, and a NaN/Inf sentinel that raises a structured diagnosis
   instead of silently poisoning the history.
+* :class:`Tracer` (:mod:`~tensordiffeq_tpu.telemetry.tracing`) —
+  end-to-end span tracing: one served query's admission → router →
+  batcher → engine → dispatch tree, one training chunk's
+  data/dispatch/device split, recorded as ``trace`` events in the same
+  run log and exported to Perfetto/chrome://tracing via
+  :func:`~tensordiffeq_tpu.telemetry.tracing.to_perfetto`.  Structured
+  failures carry the ``trace_id`` that finds their span tree.
+* :mod:`~tensordiffeq_tpu.telemetry.costmodel` — the in-library FLOP/MFU
+  accounting (XLA cost analysis + analytic floor + basis substitution,
+  formerly bench-only): live ``cost.*`` gauges during a
+  telemetry-attached fit, per-program pricing in the serving engine.
+* :class:`SLOSet` / :func:`to_prometheus`
+  (:mod:`~tensordiffeq_tpu.telemetry.slo`) — declared objectives
+  (serving p99, shed/timeout fractions, step-time regression) evaluated
+  against registry state with burn rates, plus the Prometheus text
+  exposition of the whole registry.
 * :func:`report` / :func:`summarize` — render a run directory's JSONL
   into a human diagnosis (divergence point, λ saturation, slowest phase,
-  memory peak).
+  memory peak, slowest traces, SLO verdict).
 
 Typical use::
 
@@ -43,6 +59,11 @@ from .registry import (Counter, Gauge, Histogram,  # noqa: F401
 from .runlog import (EVENTS_FILE, MANIFEST_FILE,  # noqa: F401
                      SCHEMA_VERSION, RunLogger, active_logger, log_event,
                      read_events, read_manifest)
+from . import costmodel, slo, tracing  # noqa: F401
+from .tracing import (Span, Tracer, active_tracer,  # noqa: F401
+                      attach_trace, current_trace_id, to_perfetto)
+from .costmodel import StepCostModel  # noqa: F401
+from .slo import SLOSet, to_prometheus  # noqa: F401
 from .hooks import (TrainingDiverged, TrainingTelemetry,  # noqa: F401
                     as_training_telemetry, lambda_summaries)
 from .report import report, summarize  # noqa: F401

@@ -1,0 +1,240 @@
+"""The in-library FLOP/MFU cost model — live, not bench-only.
+
+Until this module existed, FLOP accounting lived in ``bench.py``: the XLA
+cost-analysis read, the analytic per-step FLOP floor, and the
+flops-basis substitution (a Pallas custom call scores **zero** in XLA's
+cost model, so a pallas-engine step under-reports by orders of magnitude
+— the 2026-08-01 default capture said 0.48 GFLOP for a ~93 GFLOP step
+and quoted MFU 0.0004).  Those rules are now single-sourced here, and
+the bench harness is a thin consumer; fit- and serve-time code gets the
+same accounting **live**: a telemetry-attached fit publishes
+``cost.flops_per_step`` / ``cost.bytes_per_step`` /
+``cost.achieved_flops_per_s`` / ``cost.mfu`` gauges into its registry
+while it trains, and the serving engine prices each (kind, bucket)
+program at first touch.
+
+Cheapness: :func:`program_cost` accepts a ``jax.stages.Lowered`` as well
+as a ``Compiled`` — ``Lowered.cost_analysis()`` runs HLO cost analysis
+without the XLA backend compile, so live instrumentation costs one
+re-trace (milliseconds), never a second compile.
+
+The basis discipline (disclosed in every consumer as ``flops_basis``):
+
+* ``"compiled"`` — the program's own cost-analysis count, kept whenever
+  it is physically plausible (>= the analytic floor).
+* a fallback label (e.g. ``"generic-engine"``) — the substituted basis
+  when the count is below the floor, i.e. the cost model was blinded by
+  a custom call.
+* ``"analytic-floor"`` — no fallback available: the floor itself is
+  quoted as a disclosed **lower bound** (so live MFU is a lower bound).
+* ``None`` — nothing plausible to quote: no basis, no MFU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence, Tuple
+
+from .registry import MetricsRegistry, default_registry
+
+# Dense bf16 peak FLOP/s per chip (public figures; the MFU denominator).
+# The fp32 path runs below these peaks by design — quoting the bf16 basis
+# is the standard, conservative convention.
+PEAK_FLOPS = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+#: minimum forward-equivalent passes in one SA train step: forward +
+#: backward over params and λ cost at least 3 forward passes of MACs
+STEP_FORWARD_PASSES = 3.0
+
+
+def peak_flops_for(device_kind: str) -> Optional[float]:
+    """Chip peak for a JAX ``device_kind`` string, or None (unknown kind,
+    and always on CPU — there is no meaningful peak to quote against)."""
+    dk = str(device_kind).lower()
+    for key, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in dk:
+            return val
+    return None
+
+
+def default_peak() -> Optional[float]:
+    """The live-instrumentation MFU denominator: ``TDQ_PEAK_FLOPS`` env
+    override (float; lets a CPU test or an unlisted chip quote MFU), else
+    the current backend's device kind when it is a TPU, else None."""
+    env = os.environ.get("TDQ_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        if jax.default_backend() != "tpu":
+            return None
+        return peak_flops_for(jax.devices()[0].device_kind)
+    except Exception:
+        return None
+
+
+# -------------------------------------------------------------------------- #
+# program cost reads
+# -------------------------------------------------------------------------- #
+def program_cost(program) -> dict:
+    """``{"flops": float|None, "bytes_accessed": float|None}`` from a
+    compiled executable's — or a ``Lowered``'s — ``cost_analysis()``.
+    Non-positive / missing entries map to None (the XLA cost model does
+    not expose them on every backend)."""
+    out = {"flops": None, "bytes_accessed": None}
+    try:
+        ca = program.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return out
+        for key, field in (("flops", "flops"),
+                           ("bytes accessed", "bytes_accessed")):
+            v = ca.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                out[field] = float(v)
+    except Exception:
+        pass
+    return out
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """FLOPs from a program's cost analysis (None if the backend doesn't
+    expose it) — the single-sourced read ``bench.py`` quotes."""
+    return program_cost(compiled)["flops"]
+
+
+# -------------------------------------------------------------------------- #
+# analytic floor + basis substitution
+# -------------------------------------------------------------------------- #
+def analytic_mlp_flops(dims: Sequence[int], n_points: int,
+                       passes: float = 1.0) -> float:
+    """Model FLOPs of ``passes`` forward-equivalent passes of a dense MLP
+    (``2 * sum(d_i * d_{i+1})`` MACs per point per pass) over
+    ``n_points`` rows."""
+    dims = list(dims)
+    per_pt = 2 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    return float(passes) * per_pt * int(n_points)
+
+
+def analytic_step_floor(n_points: int, dims: Sequence[int]) -> float:
+    """Lower bound on model FLOPs for one SA train step: forward +
+    backward over the collocation batch alone (>= 3 forward-equivalent
+    passes).  A compiled-step count below this is physically impossible —
+    it means XLA's cost model could not see into a custom call (pallas
+    kernels score 0, so a pallas-engine step reports only its non-kernel
+    scraps)."""
+    return analytic_mlp_flops(dims, n_points, passes=STEP_FORWARD_PASSES)
+
+
+def resolve_flop_basis(measured: Optional[float], floor: float,
+                       fallback: Optional[Callable[[], Tuple[
+                           Optional[float], Optional[str]]]] = None,
+                       ) -> Tuple[Optional[float], Optional[str]]:
+    """``(flops, basis)``: keep the program's OWN count when physically
+    plausible (>= ``floor``; a fused Taylor engine legitimately executes
+    fewer logical flops than generic autodiff, and its MFU is quoted on
+    its own program with the basis disclosed).  A count below the floor
+    (= a cost model blinded by a custom call) substitutes ``fallback()``
+    — which returns its own ``(flops, label)`` — and a known-truncated
+    count is never quoted: no basis -> no MFU."""
+    if measured is not None and measured >= floor:
+        return measured, "compiled"
+    if fallback is not None:
+        flops, label = fallback()
+        if flops is not None:
+            return flops, label
+    return None, None
+
+
+def mfu(flops_per_step: Optional[float], steps_per_sec: float,
+        n_chips: int = 1, peak: Optional[float] = None) -> Optional[float]:
+    """Achieved FLOP/s over chip peak, or None when either side is
+    unknown."""
+    if flops_per_step is None or not peak or n_chips < 1:
+        return None
+    return flops_per_step * steps_per_sec / n_chips / peak
+
+
+# -------------------------------------------------------------------------- #
+# live instrumentation
+# -------------------------------------------------------------------------- #
+class StepCostModel:
+    """Live per-step cost gauges for a training loop.
+
+    Feed it the step program once (:meth:`observe_program`) and every
+    timed chunk (:meth:`observe_steps`); it publishes
+
+    * ``cost.flops_per_step`` / ``cost.bytes_per_step`` gauges (labeled
+      ``phase=``) from the program's cost analysis, guarded by the
+      analytic floor: a below-floor count is replaced by the floor
+      itself with ``basis="analytic-floor"`` (a disclosed lower bound —
+      live fit code has no generic-engine rebuild to substitute, unlike
+      the bench harness);
+    * ``cost.achieved_flops_per_s`` and — when a chip peak is known
+      (:func:`default_peak`) — ``cost.mfu``, updated per chunk.
+
+    Everything is best-effort: a backend without cost analysis leaves
+    the gauges unset and the training loop untouched.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 phase: str = "train", floor: Optional[float] = None,
+                 peak: Optional[float] = None, n_chips: int = 1):
+        self.registry = registry if registry is not None else default_registry()
+        self.phase = str(phase)
+        self.floor = floor
+        self.peak = peak if peak is not None else default_peak()
+        self.n_chips = max(int(n_chips), 1)
+        self.flops_per_step: Optional[float] = None
+        self.bytes_per_step: Optional[float] = None
+        self.basis: Optional[str] = None
+
+    def _scope(self):
+        return self.registry.scope(phase=self.phase)
+
+    def observe_program(self, program, n_steps: int = 1) -> dict:
+        """Read one program's cost (a ``Lowered`` is enough — no second
+        compile) executing ``n_steps`` steps; apply the floor guard; set
+        the per-step gauges.  Returns the resolved cost dict."""
+        cost = program_cost(program)
+        n = max(int(n_steps), 1)
+        flops = cost["flops"] / n if cost["flops"] is not None else None
+        self.bytes_per_step = (cost["bytes_accessed"] / n
+                               if cost["bytes_accessed"] is not None else None)
+        if self.floor is not None:
+            resolved, basis = resolve_flop_basis(
+                flops, self.floor,
+                fallback=lambda: (self.floor, "analytic-floor"))
+            self.flops_per_step, self.basis = resolved, basis
+        else:
+            self.flops_per_step = flops
+            self.basis = "compiled" if flops is not None else None
+        scope = self._scope()
+        if self.flops_per_step is not None:
+            scope.gauge("cost.flops_per_step").set(self.flops_per_step)
+        if self.bytes_per_step is not None:
+            scope.gauge("cost.bytes_per_step").set(self.bytes_per_step)
+        return {"flops_per_step": self.flops_per_step,
+                "bytes_per_step": self.bytes_per_step, "basis": self.basis}
+
+    def observe_steps(self, n_steps: int, wall_s: float) -> Optional[float]:
+        """Update the live throughput gauges from one timed chunk.
+        Returns the MFU (None when unquotable)."""
+        if self.flops_per_step is None or wall_s <= 0 or n_steps < 1:
+            return None
+        rate = self.flops_per_step * n_steps / wall_s / self.n_chips
+        scope = self._scope()
+        scope.gauge("cost.achieved_flops_per_s").set(rate)
+        m = mfu(self.flops_per_step, n_steps / wall_s, self.n_chips,
+                self.peak)
+        if m is not None:
+            scope.gauge("cost.mfu").set(m)
+        return m

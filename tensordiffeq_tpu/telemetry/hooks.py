@@ -23,17 +23,24 @@ from typing import Optional
 import numpy as np
 
 from ..profiling import percentiles
+from .costmodel import StepCostModel
 from .registry import MetricsRegistry, default_registry
 from .runlog import RunLogger
+from .tracing import active_tracer, attach_trace, current_trace_id
 
 
 class TrainingDiverged(RuntimeError):
     """The NaN/Inf sentinel tripped: a loss component went non-finite.
 
-    Carries ``phase`` ("adam" / "l-bfgs"), ``epoch`` (run-relative), and
-    ``components`` (the loss dict at the trip) so callers can diagnose
-    programmatically instead of parsing a message.
+    Carries ``phase`` ("adam" / "l-bfgs"), ``epoch`` (run-relative),
+    ``components`` (the loss dict at the trip), and — when a
+    :class:`~tensordiffeq_tpu.telemetry.Tracer` was active — the
+    ``trace_id`` whose span tree locates the failing step in the run
+    log, so callers can diagnose programmatically instead of parsing a
+    message.
     """
+
+    trace_id: Optional[str] = None
 
     def __init__(self, phase: str, epoch: int, components: Optional[dict] = None):
         self.phase = phase
@@ -100,18 +107,31 @@ class TrainingTelemetry:
         un-instrumented run — required when the run IS the measurement
         (``bench.py --full``), where even one extra reduction per step
         would skew the headline against earlier captures.
+      cost_model: price the compiled step through
+        :mod:`~tensordiffeq_tpu.telemetry.costmodel` — live
+        ``cost.flops_per_step`` / ``cost.bytes_per_step`` /
+        ``cost.achieved_flops_per_s`` / ``cost.mfu`` gauges in the
+        registry, updated every chunk.  Reads a ``Lowered``'s cost
+        analysis, so it never costs a second XLA compile and never
+        changes the compiled program.
     """
 
     def __init__(self, logger: Optional[RunLogger] = None,
                  registry: Optional[MetricsRegistry] = None,
                  log_every: int = 1, raise_on_divergence: bool = True,
-                 grad_norm: bool = True):
+                 grad_norm: bool = True, cost_model: bool = True):
         self.logger = logger
         self.registry = registry if registry is not None else (
             logger.registry if logger is not None else default_registry())
         self.log_every = int(log_every)
         self.raise_on_divergence = bool(raise_on_divergence)
         self.grad_norm = bool(grad_norm)
+        self.cost_model = bool(cost_model)
+        # the solver sets this before fit_adam runs so the floor guard
+        # (costmodel.analytic_step_floor) rides into on_step_program
+        self.cost_floor: Optional[float] = None
+        self._cost: Optional[StepCostModel] = None
+        self._last_step_trace: Optional[str] = None
         # run-relative rebasing across causal-ε stages / resumed legs:
         # the solver sets this so event epochs stay monotonic
         self.epoch_offset = 0
@@ -139,11 +159,34 @@ class TrainingTelemetry:
             self.event("epoch", phase=phase, epoch=epoch, losses=losses,
                        grad_norm=row.get("Grad_norm"))
 
+    def on_step_program(self, phase: str, lower_fn, n_steps: int):
+        """Price the compiled step: ``lower_fn()`` returns the chunk
+        runner's ``Lowered`` (cost analysis without a second compile) for
+        a program executing ``n_steps`` steps.  Publishes the per-step
+        ``cost.*`` gauges (floor-guarded via ``cost_floor``) and a
+        ``step_cost`` event.  Best-effort: a backend without cost
+        analysis leaves the gauges unset and never disturbs the fit."""
+        if not self.cost_model:
+            return
+        try:
+            self._cost = StepCostModel(registry=self.registry, phase=phase,
+                                       floor=self.cost_floor)
+            cost = self._cost.observe_program(lower_fn(), n_steps=n_steps)
+        except Exception:
+            self._cost = None
+            return
+        if cost["flops_per_step"] is not None:
+            self.event("step_cost", phase=phase, **cost)
+
     def on_step_time(self, phase: str, n_steps: int, dispatch_s: float,
                      device_s: float, data_s: float = 0.0):
         """Chunk step-time split: host dispatch (time until the async jit
         call returned) vs device wait (``block_until_ready`` fence) vs
-        data prep (batch rebuilds)."""
+        data prep (batch rebuilds).  With a tracer active, the split is
+        also recorded as a ``train.step`` span with data/dispatch/device
+        children; with a cost model primed (:meth:`on_step_program`),
+        the live throughput gauges (``cost.achieved_flops_per_s``,
+        ``cost.mfu``) update from the fenced wall time."""
         n = max(int(n_steps), 1)
         scope = self.registry.scope(phase=phase)
         scope.histogram("step_time_dispatch_s").observe(dispatch_s / n)
@@ -152,6 +195,32 @@ class TrainingTelemetry:
             scope.histogram("step_time_data_s").observe(data_s / n)
         self.event("step_time", phase=phase, n_steps=n_steps,
                    dispatch_s=dispatch_s, device_s=device_s, data_s=data_s)
+        if self._cost is not None and self._cost.phase == phase:
+            self._cost.observe_steps(n, dispatch_s + device_s)
+        tr = active_tracer()
+        if tr is not None:
+            # the chunk just ENDED (this hook runs after the fence), so
+            # the root is backdated to the chunk's wall start and the
+            # children laid back-to-back inside it — Perfetto renders
+            # the real timeline, not an interval after the fact
+            total = dispatch_s + device_s + data_s
+            root = tr.open_span("train.step", parent=None, phase=phase,
+                                n_steps=n)
+            root.t_start -= total
+            t0 = root.t_start
+            if data_s:
+                tr.record_span("train.data", data_s, parent=root,
+                               phase=phase, t_start=t0)
+                t0 += data_s
+            tr.record_span("train.dispatch", dispatch_s, parent=root,
+                           phase=phase, t_start=t0)
+            tr.record_span("train.device", device_s, parent=root,
+                           phase=phase, t_start=t0 + dispatch_s)
+            tr.close_span(root, duration_s=total)
+            # remembered so a divergence detected in THIS chunk's rows
+            # (the sentinel runs right after the step-time fence) can
+            # point at the chunk's span tree
+            self._last_step_trace = root.trace_id
 
     def on_lambda_stats(self, epoch: int, lambdas: dict):
         stats = lambda_summaries(lambdas)
@@ -174,10 +243,19 @@ class TrainingTelemetry:
         epoch = int(epoch) + self.epoch_offset
         components = {k: v for k, v in row.items()}
         self.registry.counter("divergences", phase=phase).inc()
+        extra = {}
+        # the chunk's span tree locates the step: the live span if one is
+        # open, else the train.step trace the fence just recorded
+        tid = current_trace_id() or self._last_step_trace
+        if tid is not None:
+            extra["trace"] = tid
         self.event("divergence", phase=phase, epoch=epoch,
-                   components=components, level="error")
+                   components=components, level="error", **extra)
         if self.raise_on_divergence and phase == "adam":
-            raise TrainingDiverged(phase, epoch, components)
+            exc = attach_trace(TrainingDiverged(phase, epoch, components))
+            if exc.trace_id is None and tid is not None:
+                exc.trace_id = tid
+            raise exc
 
     def check_rows(self, phase: str, first_epoch: int, rows: list):
         """Run the sentinel over a chunk's per-epoch rows, tripping at the

@@ -13,6 +13,8 @@ from __future__ import annotations
 import numpy as np
 
 from .runlog import NONFINITE_TOKENS, read_events, read_manifest
+from .slo import SLOSet
+from .tracing import slowest_root, span_tree
 
 # λ saturation heuristic: a per-point λ distribution whose p99 runs this
 # many times past its mean is dominated by a thin set of runaway points —
@@ -102,9 +104,19 @@ def summarize(run_dir: str) -> dict:
         if e.get("memory_peak_bytes"):
             mem_peak = max(mem_peak or 0, e["memory_peak_bytes"])
 
+    trace_events = of_kind("trace")
     return {
         "manifest": manifest,
         "n_events": len(events),
+        # span layer (PR 7): raw trace events + the two slowest roots the
+        # report narrates (requests vs training-step chunks)
+        "trace_events": trace_events,
+        "slowest_request": slowest_root(
+            [t for t in trace_events
+             if not str(t.get("name", "")).startswith("train.")]),
+        "slowest_train_step": slowest_root(trace_events, "train.step"),
+        "slo": SLOSet.default().evaluate(manifest.get("metrics") or {},
+                                         events),
         "config": (of_kind("run_config") or [{}])[-1],
         "losses": losses,
         "divergences": divergences,
@@ -296,6 +308,53 @@ def report(run_dir: str, width: int = 72) -> str:
             f"{agg['device_s']:.2f}s / data {agg['data_s']:.2f}s "
             f"-> slowest phase: {slowest} "
             f"({agg[f'{slowest}_s'] / total:.0%} of measured wall)")
+
+    # -- trace layer: the slowest end-to-end paths ---------------------- #
+    def _render_span(sp, indent):
+        dur = float(sp.get("dur_s") or 0.0)
+        attrs = sp.get("attrs") or {}
+        extras = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(attrs.items()))
+        status = "" if sp.get("status") != "error" else " [ERROR]"
+        lines.append(f"{'  ' * indent}{sp.get('name')}: "
+                     f"{dur * 1e3:.2f}ms"
+                     + (f" ({extras})" if extras else "") + status)
+        for child in sorted(sp.get("children", []),
+                            key=lambda c: c.get("start") or 0):
+            _render_span(child, indent + 1)
+
+    if s["trace_events"]:
+        n_traces = len(span_tree(s["trace_events"]))
+        lines.append(f"TRACE: {len(s['trace_events'])} spans over "
+                     f"{n_traces} traces")
+        if s["slowest_request"] is not None:
+            lines.append(
+                f"  slowest request "
+                f"(trace {s['slowest_request'].get('trace')}):")
+            _render_span(s["slowest_request"], 2)
+        if s["slowest_train_step"] is not None:
+            lines.append(
+                f"  slowest training-step chunk "
+                f"(trace {s['slowest_train_step'].get('trace')}):")
+            _render_span(s["slowest_train_step"], 2)
+        errs = [t for t in s["trace_events"] if t.get("status") == "error"]
+        if errs:
+            lines.append(f"  {len(errs)} span(s) ended in error; first: "
+                         f"{errs[0].get('name')} trace {errs[0].get('trace')}"
+                         f" ({_fmt(errs[0].get('error'))})")
+
+    # -- SLO verdict ---------------------------------------------------- #
+    slo = s["slo"]
+    with_data = {k: o for k, o in slo["objectives"].items()
+                 if o["ok"] is not None}
+    if with_data:
+        lines.append("SLO: " + ("all objectives met"
+                                if slo["ok"] else
+                                "BREACH — " + ", ".join(slo["breaches"])))
+        for name, o in sorted(with_data.items()):
+            mark = "ok" if o["ok"] else "BREACH"
+            lines.append(
+                f"  {name}: {_fmt(o['value'])} vs <= {_fmt(o['threshold'])}"
+                f" ({mark}, burn {_fmt(o['burn_rate'])}x)")
 
     if s["checkpoints"]:
         lines.append(f"checkpoints written: {s['checkpoints']}")

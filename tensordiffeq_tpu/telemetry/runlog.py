@@ -7,7 +7,7 @@ A run directory holds two files:
   finalized on :meth:`RunLogger.close` with the end time and a metrics
   snapshot from the attached registry.
 * ``events.jsonl`` — append-only, one schema-versioned JSON record per
-  line: ``{"v": 1, "t": <unix s>, "kind": "...", ...}``.  Appends are
+  line: ``{"v": 2, "t": <unix s>, "kind": "...", ...}``.  Appends are
   flushed per event, so a killed run keeps everything up to the kill.
 
 :func:`log_event` is the single narration path the package routes its
@@ -32,7 +32,12 @@ import numpy as np
 
 from .registry import MetricsRegistry, default_registry
 
-SCHEMA_VERSION = 1
+# v2 (PR 7): adds the `trace` event kind (span records — trace/span/
+# parent ids, start, dur_s, status, attrs) and the `step_cost` event.
+# Reads stay back-compatible: neither read_events nor any consumer
+# filters on `v`, so v1 logs parse, summarize, and report unchanged —
+# they simply contain no spans.
+SCHEMA_VERSION = 2
 EVENTS_FILE = "events.jsonl"
 MANIFEST_FILE = "manifest.json"
 

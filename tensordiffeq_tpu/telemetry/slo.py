@@ -1,0 +1,283 @@
+"""Service-level objectives: declared targets, machine-checkable verdicts.
+
+The registry and run log record what happened; this module says whether
+it was *acceptable*.  An :class:`SLOSet` declares the fleet's objectives
+— serving p99 latency, shed (rejected) fraction, timed-out fraction,
+training step-time regression against the run's own baseline — and
+evaluates them against live registry state (:meth:`SLOSet.evaluate`) or
+a finished/killed run directory (:meth:`SLOSet.evaluate_run`).  Every
+objective reports a burn rate (observed value over budget, the
+burn-rate-window idiom: >1 means the error budget is being spent faster
+than allowed; the step-regression objective compares a trailing window
+against the run's opening baseline window rather than a global mean, so
+a late regression is not averaged away).
+
+The verdict is plain JSON (``{"ok": bool, "objectives": {...},
+"breaches": [...]}``) consumed by three front-ends:
+:meth:`~tensordiffeq_tpu.fleet.FleetRouter.autoscale_signals` (scale-up
+on burn), ``telemetry.report`` (the SLO block in the human diagnosis),
+and ``bench.py --slo`` (CI gate: nonzero exit on breach).
+
+:func:`to_prometheus` renders any registry (or its ``as_dict()``) in
+Prometheus text exposition format — a pure formatter, no server: dump it
+behind any HTTP handler or into a textfile-collector drop and the
+existing dashboards scrape it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from .runlog import read_events, read_manifest
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(\{(?P<labels>.*)\})?$", re.DOTALL)
+
+
+def _parse_key(key: str):
+    """Split a registry key ``name{a=b,c=d}`` into (name, {labels})."""
+    m = _KEY_RE.match(key)
+    if m is None:
+        return key, {}
+    labels = {}
+    for part in (m.group("labels") or "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _sum_counters(metrics: dict, base: str) -> float:
+    """Sum every labeled instance of counter ``base`` in an ``as_dict()``
+    snapshot."""
+    total = 0.0
+    for key, v in (metrics.get("counters") or {}).items():
+        if _parse_key(key)[0] == base and isinstance(v, (int, float)):
+            total += v
+    return total
+
+
+def _max_hist_p99(metrics: dict, base: str) -> Optional[float]:
+    """Worst p99 across every labeled instance of histogram ``base``."""
+    worst = None
+    for key, summ in (metrics.get("histograms") or {}).items():
+        if _parse_key(key)[0] != base or not isinstance(summ, dict):
+            continue
+        p99 = summ.get("p99")
+        if isinstance(p99, (int, float)):
+            worst = p99 if worst is None else max(worst, p99)
+    return worst
+
+
+def _objective(value, threshold) -> dict:
+    """One objective's verdict row.  ``ok`` is None when there is no
+    data — absence of traffic is not a breach."""
+    ok = None if value is None else bool(value <= threshold)
+    burn = (None if value is None or threshold <= 0
+            else round(value / threshold, 4))
+    return {"value": value, "threshold": threshold, "ok": ok,
+            "burn_rate": burn}
+
+
+class SLOSet:
+    """Declared objectives + their evaluation (see module docstring).
+
+    Args:
+      serving_p99_s: worst acceptable per-request p99 latency across
+        every serving batcher (``serving.batcher.latency_s``).
+      max_rejected_fraction: budget for shed traffic — batcher fast-fail
+        rejections plus admission-control sheds, over all finished
+        requests.
+      max_timeout_fraction: budget for requests whose deadline expired
+        before their batch executed.
+      max_step_regression: trailing-window training step time over the
+        run's own opening-baseline window (1.5 = "no more than 50%
+        slower than the run started out").
+      window: events per window for the step-regression comparison.
+    """
+
+    def __init__(self, serving_p99_s: float = 0.25,
+                 max_rejected_fraction: float = 0.05,
+                 max_timeout_fraction: float = 0.01,
+                 max_step_regression: float = 1.5,
+                 window: int = 20):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.serving_p99_s = float(serving_p99_s)
+        self.max_rejected_fraction = float(max_rejected_fraction)
+        self.max_timeout_fraction = float(max_timeout_fraction)
+        self.max_step_regression = float(max_step_regression)
+        self.window = int(window)
+
+    @classmethod
+    def default(cls) -> "SLOSet":
+        return cls()
+
+    # ------------------------------------------------------------------ #
+    def evaluate(self, metrics, events: Optional[list] = None) -> dict:
+        """Verdict over a registry (or its ``as_dict()`` snapshot) and,
+        when ``events`` are given, the run's ``step_time`` trail for the
+        regression objective."""
+        if hasattr(metrics, "as_dict"):  # a registry (or registry-like)
+            metrics = metrics.as_dict()
+        metrics = metrics or {}
+
+        served = _sum_counters(metrics, "serving.batcher.requests")
+        failed = _sum_counters(metrics, "serving.batcher.failed")
+        timed_out = _sum_counters(metrics, "serving.batcher.timed_out")
+        rejected = (_sum_counters(metrics, "serving.batcher.rejected")
+                    + _sum_counters(metrics, "fleet.admission.rejected"))
+        finished = served + failed + timed_out + rejected
+
+        objectives = {
+            "serving_p99_s": _objective(
+                _max_hist_p99(metrics, "serving.batcher.latency_s"),
+                self.serving_p99_s),
+            "rejected_fraction": _objective(
+                round(rejected / finished, 6) if finished else None,
+                self.max_rejected_fraction),
+            "timed_out_fraction": _objective(
+                round(timed_out / finished, 6) if finished else None,
+                self.max_timeout_fraction),
+            "step_time_regression": _objective(
+                self._step_regression(events or []),
+                self.max_step_regression),
+        }
+        breaches = sorted(k for k, o in objectives.items()
+                          if o["ok"] is False)
+        return {"ok": not breaches, "objectives": objectives,
+                "breaches": breaches}
+
+    def _step_regression(self, events: list) -> Optional[float]:
+        """Trailing-window mean per-step time over the opening-baseline
+        window, from ``step_time`` events (any phase, per-step
+        normalised).  None until both windows have data — and the two
+        windows must not overlap, or a short run would compare a sample
+        against itself."""
+        per_step = []
+        for e in events:
+            if e.get("kind") != "step_time":
+                continue
+            n = e.get("n_steps") or 0
+            total = sum(float(e.get(k) or 0.0)
+                        for k in ("dispatch_s", "device_s", "data_s"))
+            if n and total > 0:
+                per_step.append(total / n)
+        if len(per_step) < 2 * self.window:
+            return None
+        base = per_step[:self.window]
+        cur = per_step[-self.window:]
+        baseline = sum(base) / len(base)
+        current = sum(cur) / len(cur)
+        if baseline <= 0:
+            return None
+        return round(current / baseline, 4)
+
+    def evaluate_run(self, run_dir: str) -> dict:
+        """Verdict for a run directory: the manifest's closing metrics
+        snapshot (empty for a killed run — objectives then report no
+        data rather than a fake pass/fail) + the events trail."""
+        try:
+            metrics = read_manifest(run_dir).get("metrics") or {}
+        except OSError:
+            metrics = {}
+        return self.evaluate(metrics, read_events(run_dir))
+
+
+# -------------------------------------------------------------------------- #
+# Prometheus text exposition
+# -------------------------------------------------------------------------- #
+def _prom_name(name: str) -> str:
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _prom_label_value(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace(
+        '"', r'\"')
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{_prom_name(k)}="{_prom_label_value(v)}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def to_prometheus(metrics) -> str:
+    """Render a :class:`~tensordiffeq_tpu.telemetry.MetricsRegistry` (or
+    its ``as_dict()``) in Prometheus text exposition format 0.0.4.
+
+    Counters keep their value under ``<name>_total``; gauges render
+    plainly; histograms render as Prometheus *summaries* (``quantile``
+    labels from the reservoir percentiles, plus ``_sum`` / ``_count``)
+    with min/max as companion gauges.  Dots become underscores; unset
+    gauges and empty histograms are skipped (no fake zeros).  Pure
+    formatter — serve the string from any handler you already run."""
+    if hasattr(metrics, "as_dict"):  # a registry (or registry-like)
+        metrics = metrics.as_dict()
+    metrics = metrics or {}
+    lines = []
+    typed = set()
+
+    def head(pname: str, ptype: str):
+        if pname not in typed:
+            typed.add(pname)
+            lines.append(f"# TYPE {pname} {ptype}")
+
+    for key, v in sorted((metrics.get("counters") or {}).items()):
+        if not isinstance(v, (int, float)):
+            continue
+        base, labels = _parse_key(key)
+        pname = _prom_name(base) + "_total"
+        head(pname, "counter")
+        lines.append(f"{pname}{_prom_labels(labels)} {v}")
+
+    for key, v in sorted((metrics.get("gauges") or {}).items()):
+        if not isinstance(v, (int, float)):
+            continue
+        base, labels = _parse_key(key)
+        pname = _prom_name(base)
+        head(pname, "gauge")
+        lines.append(f"{pname}{_prom_labels(labels)} {v}")
+
+    # histograms: group by family FIRST — the exposition format requires
+    # every sample of a metric family to be one contiguous block, so the
+    # summary lines of all labeled instances are emitted together and the
+    # companion _min/_max gauge families follow as their own blocks
+    # (interleaving them per instance would split the summary family and
+    # fail strict parsers on multi-tenant registries)
+    families: dict = {}
+    for key, summ in sorted((metrics.get("histograms") or {}).items()):
+        if not isinstance(summ, dict) or not summ.get("count"):
+            continue
+        base, labels = _parse_key(key)
+        families.setdefault(base, []).append((labels, summ))
+    for base, instances in sorted(families.items()):
+        pname = _prom_name(base)
+        head(pname, "summary")
+        for labels, summ in instances:
+            for q in ("p50", "p90", "p99"):
+                qv = summ.get(q)
+                if isinstance(qv, (int, float)):
+                    lines.append(
+                        f"{pname}"
+                        f"{_prom_labels(labels, {'quantile': '0.' + q[1:]})}"
+                        f" {qv}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} {summ['sum']}")
+            lines.append(
+                f"{pname}_count{_prom_labels(labels)} {summ['count']}")
+        for bound in ("min", "max"):
+            rows = [(labels, summ[bound]) for labels, summ in instances
+                    if isinstance(summ.get(bound), (int, float))]
+            if not rows:
+                continue
+            bname = f"{pname}_{bound}"
+            head(bname, "gauge")
+            for labels, bv in rows:
+                lines.append(f"{bname}{_prom_labels(labels)} {bv}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,381 @@
+"""End-to-end span tracing: follow ONE request or ONE training step.
+
+The registry answers "how is the fleet doing on aggregate"; the run log
+answers "what happened, in order".  Neither answers the on-call question:
+*this* query died — where?  A :class:`Tracer` stitches the missing layer:
+every instrumented stage (admission → router → batcher → engine →
+dispatch for serving; step → data/dispatch/device for training) opens a
+**span** — trace id, span id, parent id, monotonic duration, status,
+attributes — and each span lands in the active
+:class:`~tensordiffeq_tpu.telemetry.RunLogger` as a schema-versioned
+``trace`` event.  No new sink: spans ride ``events.jsonl`` next to the
+epoch/divergence/admission events they explain, so one file root-causes a
+failure (the structured errors — ``AdmissionRejected``,
+``RequestTimeout``, ``CircuitOpenError``, ``TrainingDiverged`` — carry
+the ``trace_id`` that finds their span tree).
+
+Cost discipline mirrors :func:`~tensordiffeq_tpu.resilience.active_chaos`:
+with no tracer entered, every instrumentation site is **one stack probe**
+(:func:`active_tracer` is a list peek) and the serving results are
+bit-identical to an uninstrumented run — tracing never touches device
+code, only host-side timestamps around it.
+
+Usage::
+
+    with telemetry.RunLogger("runs/fleet") as run, telemetry.Tracer():
+        router.query("tenant-a", X)          # spans land in events.jsonl
+    telemetry.tracing.to_perfetto("runs/fleet")   # -> chrome://tracing
+
+Spans use wall-clock start times (Perfetto timeline placement) and
+``perf_counter`` durations (monotonic, immune to clock steps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+from .runlog import EVENTS_FILE, RunLogger, active_logger, read_events
+
+# stack, not a slot: a fleet host may trace serving while a nested tool
+# traces its own phase — innermost wins, same discipline as the runlog
+_ACTIVE: list = []
+
+_UNSET = object()
+
+
+def active_tracer() -> Optional["Tracer"]:
+    """The innermost entered :class:`Tracer`, or None.  ONE list peek —
+    this is the whole disabled-path cost, and the per-request bound the
+    overhead test pins."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def current_span() -> Optional["Span"]:
+    tr = _ACTIVE[-1] if _ACTIVE else None
+    return tr.current if tr is not None else None
+
+
+def current_trace_id() -> Optional[str]:
+    sp = current_span()
+    return sp.trace_id if sp is not None else None
+
+
+def attach_trace(exc: BaseException) -> BaseException:
+    """Stamp the current trace id onto a structured error (no-op without
+    an active span).  The serving/fleet/training raise sites call this so
+    ``exc.trace_id`` resolves the failure's span tree in the run log."""
+    tid = current_trace_id()
+    if tid is not None:
+        exc.trace_id = tid
+    return exc
+
+
+class Span:
+    """One timed stage of a trace (see module docstring)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t_start",
+                 "attrs", "status", "error", "_perf0", "duration_s")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str, t_start: float,
+                 perf0: float, attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = str(name)
+        self.t_start = t_start
+        self._perf0 = perf0
+        self.attrs = attrs
+        self.status = "ok"
+        self.error: Optional[str] = None
+        self.duration_s: Optional[float] = None
+
+    def set_attrs(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+
+class Tracer:
+    """Record span trees into the run log (see module docstring).
+
+    Args:
+      logger: the :class:`~tensordiffeq_tpu.telemetry.RunLogger` spans
+        are appended to; None (the default) resolves the active run
+        logger *at span close*, so one Tracer composes with nested run
+        logs the same way :func:`~tensordiffeq_tpu.telemetry.log_event`
+        does.
+      registry: metrics destination for the ``telemetry.trace.spans``
+        counter (None: the span count is still in the log).
+      clock / perf: wall-clock and monotonic time sources (injectable
+        for tests).
+      trace_prefix: trace-id prefix (default ``tr<pid hex>.<instance>``
+        — the per-process instance counter keeps ids from two Tracers
+        logging into one run dir from colliding); tests pin it for
+        deterministic ids (an explicit prefix is used verbatim, so two
+        tracers given the SAME prefix collide — give each its own).
+
+    Single-threaded by design, like the batcher event loop it
+    instruments: the open-span stack is per-tracer and hosts that poll
+    from multiple threads should enter one tracer per thread.
+    """
+
+    _n_instances = 0  # process-wide: default prefixes never collide
+
+    def __init__(self, logger: Optional[RunLogger] = None, registry=None,
+                 clock: Callable[[], float] = time.time,
+                 perf: Callable[[], float] = time.perf_counter,
+                 trace_prefix: Optional[str] = None):
+        self._logger = logger
+        self._registry = registry
+        self._clock = clock
+        self._perf = perf
+        Tracer._n_instances += 1
+        self._prefix = (trace_prefix if trace_prefix is not None
+                        else f"tr{os.getpid():x}.{Tracer._n_instances:x}")
+        self._n_traces = 0
+        self._n_spans = 0
+        self._stack: list = []
+
+    # ------------------------------------------------------------------ #
+    def __enter__(self) -> "Tracer":
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            _ACTIVE.remove(self)
+        except ValueError:
+            pass
+        return False
+
+    @property
+    def current(self) -> Optional[Span]:
+        return self._stack[-1] if self._stack else None
+
+    # ------------------------------------------------------------------ #
+    def open_span(self, name: str, parent=_UNSET, trace_id=None,
+                  **attrs) -> Span:
+        """Start a span and push it onto the open stack.  ``parent``
+        defaults to the current open span (a root span starts a new
+        trace); pass ``parent=None`` to force a new root."""
+        if parent is _UNSET:
+            parent = self.current
+        if trace_id is None:
+            if parent is not None:
+                trace_id = parent.trace_id
+            else:
+                self._n_traces += 1
+                trace_id = f"{self._prefix}-{self._n_traces:04x}"
+        self._n_spans += 1
+        sp = Span(trace_id, f"s{self._n_spans:04x}",
+                  parent.span_id if parent is not None else None,
+                  name, self._clock(), self._perf(), attrs)
+        self._stack.append(sp)
+        return sp
+
+    def close_span(self, span: Span, status: Optional[str] = None,
+                   error: Optional[BaseException] = None,
+                   duration_s: Optional[float] = None) -> Span:
+        """End a span (tolerates out-of-order closes) and emit its
+        ``trace`` event."""
+        try:
+            self._stack.remove(span)
+        except ValueError:
+            pass  # already closed — emit once anyway, never raise
+        if error is not None:
+            span.status = "error"
+            span.error = f"{type(error).__name__}: {error}"
+        if status is not None:
+            span.status = status
+        span.duration_s = (float(duration_s) if duration_s is not None
+                           else self._perf() - span._perf0)
+        self._emit(span)
+        return span
+
+    def span(self, name: str, **attrs):
+        """Context manager: ``with tracer.span("serving.engine.run",
+        bucket=256): ...`` — an exception propagating out marks the span
+        ``status=error`` (and re-raises)."""
+        return _SpanCtx(self, name, attrs)
+
+    def record_span(self, name: str, duration_s: float, parent=_UNSET,
+                    trace_id: Optional[str] = None, status: str = "ok",
+                    error: Optional[str] = None,
+                    t_start: Optional[float] = None, **attrs) -> Span:
+        """Record an already-measured span (duration known, e.g. the
+        fenced dispatch/device split a training chunk measured itself).
+        ``t_start`` places it on the wall-clock timeline (default: it
+        just ended — ``now - duration``); ``trace_id`` may target a
+        trace whose spans have closed — the batcher's deadline sweep
+        stamps timeout spans into the original request's trace this
+        way."""
+        if parent is _UNSET:
+            parent = self.current
+        if trace_id is None:
+            if parent is not None:
+                trace_id = parent.trace_id
+            else:
+                self._n_traces += 1
+                trace_id = f"{self._prefix}-{self._n_traces:04x}"
+        self._n_spans += 1
+        duration_s = max(float(duration_s), 0.0)
+        sp = Span(trace_id, f"s{self._n_spans:04x}",
+                  parent.span_id if isinstance(parent, Span) else parent,
+                  name,
+                  (float(t_start) if t_start is not None
+                   else self._clock() - duration_s), 0.0, attrs)
+        sp.status = status
+        sp.error = error
+        sp.duration_s = duration_s
+        self._emit(sp)
+        return sp
+
+    # ------------------------------------------------------------------ #
+    def _emit(self, span: Span):
+        if self._registry is not None:
+            self._registry.counter("telemetry.trace.spans").inc()
+        lg = self._logger if self._logger is not None else active_logger()
+        if lg is None:
+            return
+        rec: dict = {"trace": span.trace_id, "span": span.span_id,
+                     "name": span.name, "start": round(span.t_start, 6),
+                     "dur_s": round(span.duration_s or 0.0, 9),
+                     "status": span.status}
+        if span.parent_id is not None:
+            rec["parent"] = span.parent_id
+        if span.error is not None:
+            rec["error"] = span.error
+        if span.attrs:
+            rec["attrs"] = span.attrs
+        lg.event("trace", **rec)
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_attrs", "_span")
+
+    def __init__(self, tracer: Tracer, name: str, attrs: dict):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        self._span = self._tracer.open_span(self._name, **self._attrs)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer.close_span(self._span, error=exc)
+        if exc is not None and not hasattr(exc, "trace_id"):
+            # best effort: structured errors define the attribute; a slots
+            # class that can't take it still propagates untouched
+            try:
+                exc.trace_id = self._span.trace_id
+            except (AttributeError, TypeError):
+                pass
+        return False
+
+
+# -------------------------------------------------------------------------- #
+# reading spans back
+# -------------------------------------------------------------------------- #
+def read_spans(run_dir: str, trace_id: Optional[str] = None) -> list:
+    """The run's ``trace`` events as dicts (optionally one trace), in
+    append order.  Torn final lines are skipped, like every runlog read."""
+    spans = read_events(run_dir, kind="trace")
+    if trace_id is not None:
+        spans = [s for s in spans if s.get("trace") == trace_id]
+    return spans
+
+
+def span_tree(spans: list) -> dict:
+    """``{trace_id: [root spans]}`` with a ``children`` list grafted onto
+    every span dict — the tree the report and the example assertions
+    walk.  Orphans (parent never closed/logged) are kept as roots rather
+    than dropped: a salvage read of a killed run must still show what it
+    has."""
+    by_trace: dict = {}
+    by_id: dict = {}
+    for s in spans:
+        s = dict(s)
+        s["children"] = []
+        by_id[(s.get("trace"), s.get("span"))] = s
+        by_trace.setdefault(s.get("trace"), []).append(s)
+    roots: dict = {}
+    for tid, group in by_trace.items():
+        roots[tid] = []
+        for s in group:
+            parent = by_id.get((tid, s.get("parent")))
+            if s.get("parent") is not None and parent is not None:
+                parent["children"].append(s)
+            else:
+                roots[tid].append(s)
+    return roots
+
+
+def _depth(span: dict, by_id: dict, limit: int = 64) -> int:
+    d = 0
+    cur = span
+    while cur.get("parent") is not None and d < limit:
+        nxt = by_id.get((cur.get("trace"), cur.get("parent")))
+        if nxt is None:
+            break
+        cur = nxt
+        d += 1
+    return d
+
+
+def to_perfetto(run_dir: str, path: Optional[str] = None) -> dict:
+    """Convert a run's ``trace`` events to Chrome trace-event JSON
+    (the ``traceEvents`` array format Perfetto and ``chrome://tracing``
+    load).  Each span becomes a complete (``"ph": "X"``) event: ``ts`` /
+    ``dur`` in microseconds, one ``pid`` per trace, ``tid`` = span depth
+    (children nest visually under their parents).  Writes ``path`` when
+    given (default ``<run_dir>/trace.perfetto.json``) and returns the
+    dict either way."""
+    spans = read_spans(run_dir)
+    by_id = {(s.get("trace"), s.get("span")): s for s in spans}
+    pids: dict = {}
+    events = []
+    for s in spans:
+        tid_key = s.get("trace")
+        pid = pids.setdefault(tid_key, len(pids) + 1)
+        args = dict(s.get("attrs") or {})
+        args["trace_id"] = s.get("trace")
+        args["span_id"] = s.get("span")
+        if s.get("error"):
+            args["error"] = s["error"]
+        events.append({
+            "name": s.get("name", "?"),
+            "cat": str(s.get("name", "?")).split(".")[0],
+            "ph": "X",
+            "ts": round(float(s.get("start", 0.0)) * 1e6, 3),
+            "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
+            "pid": pid,
+            "tid": _depth(s, by_id),
+            "args": args,
+        })
+        if s.get("status") == "error":
+            events[-1]["cname"] = "terrible"  # red in chrome://tracing
+    out = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"source": "tensordiffeq_tpu.telemetry.tracing",
+                         "run_dir": str(run_dir),
+                         "events_file": EVENTS_FILE}}
+    target = path if path is not None else os.path.join(
+        str(run_dir), "trace.perfetto.json")
+    if target:
+        with open(target, "w") as fh:
+            json.dump(out, fh)
+    return out
+
+
+def slowest_root(spans: list, name_prefix: str = "") -> Optional[dict]:
+    """The slowest root span (optionally filtered by name prefix) with
+    its children grafted — what the report's TRACE section narrates."""
+    roots = [r for group in span_tree(spans).values() for r in group
+             if str(r.get("name", "")).startswith(name_prefix)]
+    if not roots:
+        return None
+    return max(roots, key=lambda s: float(s.get("dur_s") or 0.0))

@@ -404,6 +404,18 @@ def fit_adam(loss_fn: Callable,
 
     best = (tree_copy(params), jnp.inf, jnp.asarray(-1))
     total_steps = tf_iter * n_batches
+    if telemetry is not None and total_steps > 0 \
+            and hasattr(telemetry, "on_step_program"):
+        # price the step program for the live cost.* gauges: lowering the
+        # SAME jitted runner at the first chunk's signature reads the HLO
+        # cost analysis WITHOUT a second XLA compile (Lowered.cost_analysis)
+        # and without touching the program the loop executes
+        n0 = int(min(chunk * n_batches, total_steps))
+        telemetry.on_step_program(
+            "adam",
+            lambda: run.lower(trainables, opt_state, best, X_batched,
+                              idx_batched, jnp.asarray(0), n0),
+            n_steps=n0)
     t0 = time.time()
     steps_done = 0
     data_s = 0.0  # batch-rebuild (resample) time attributed to step-time

@@ -438,3 +438,44 @@ def test_serving_json_contract_on_cpu_fallback(tmp_path):
     assert p["compile_cache_programs"] <= p["compile_cache_bound"]
     assert set(p["latency_s"]) == {"p50", "p90", "p99"}
     assert p["backend"] == "cpu"  # this env: the fallback really ran
+
+
+def test_slo_gate_contract(tmp_path):
+    """`bench.py --slo TARGET` is the CI gate over captured evidence:
+    one machine-readable verdict line, exit 0 when every objective is in
+    budget, nonzero on breach — against a bench payload JSON or a
+    telemetry run directory.  Deliberately NOT exit-0-always: the breach
+    IS the signal (the measurement modes keep their contract)."""
+    bench = _load_bench()
+    # verdict shape, in-process: breaching payload (20% timeouts)
+    bad = {"metric": "x", "telemetry": {"metrics": {
+        "counters": {"serving.batcher.requests": 80,
+                     "serving.batcher.timed_out": 20},
+        "gauges": {}, "histograms": {}}}}
+    f = tmp_path / "payload.json"
+    f.write_text(json.dumps(bad) + "\n")
+    v = bench.slo_verdict(str(f))
+    assert not v["ok"] and v["source"] == "payload"
+    assert v["breaches"] == ["timed_out_fraction"]
+    assert v["objectives"]["timed_out_fraction"]["burn_rate"] == 20.0
+    # a healthy run DIRECTORY evaluates via its manifest metrics
+    from tensordiffeq_tpu.telemetry import MetricsRegistry, RunLogger
+    reg = MetricsRegistry()
+    reg.counter("serving.batcher.requests").inc(100)
+    reg.histogram("serving.batcher.latency_s").observe(0.001)
+    run_dir = tmp_path / "run"
+    with RunLogger(str(run_dir), run_id="ok", registry=reg):
+        pass
+    v = bench.slo_verdict(str(run_dir))
+    assert v["ok"] and v["source"] == "run_dir"
+
+    # subprocess exit-code contract (one spawn — tier-1 wall budget; the
+    # ok-direction exit path is `sys.exit(0 if verdict["ok"] ...)` on the
+    # same verdict dict asserted in-process above)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--slo", str(f)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode != 0
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["breaches"] == ["timed_out_fraction"]

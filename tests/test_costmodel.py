@@ -1,0 +1,220 @@
+"""In-library cost model (telemetry.costmodel): floor math, the
+flops-basis substitution rules, live gauges during a CPU fit (the ISSUE's
+acceptance pin, analytic-floor guard included), serve-time per-rung
+pricing, and the bench.py dedupe (thin consumers, same disclosures)."""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import telemetry
+from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger,
+                                        TrainingTelemetry, costmodel)
+
+from test_solver import make_burgers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def burgers_solver():
+    """One tiny compiled solver shared by the fit/engine tests (compile
+    once — the suite is compile-dominated)."""
+    domain, bcs, f_model = make_burgers(n_f=128, nx=8, nt=5)
+    s = tdq.CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, fused=False)
+    return s
+
+
+class FakeProgram:
+    """Stands in for a Compiled/Lowered: just exposes cost_analysis()."""
+
+    def __init__(self, flops=None, bytes_accessed=None, raises=False):
+        self._ca = {}
+        if flops is not None:
+            self._ca["flops"] = flops
+        if bytes_accessed is not None:
+            self._ca["bytes accessed"] = bytes_accessed
+        self._raises = raises
+
+    def cost_analysis(self):
+        if self._raises:
+            raise RuntimeError("not exposed on this backend")
+        return self._ca
+
+
+# --------------------------------------------------------------------------- #
+# pure rules
+# --------------------------------------------------------------------------- #
+def test_analytic_floor_math():
+    # [2, 8, 8, 1]: 2*(16 + 64 + 8) = 176 MACs/pt/pass, 3 passes, 100 pts
+    assert costmodel.analytic_mlp_flops([2, 8, 8, 1], 100) == 17_600
+    assert costmodel.analytic_step_floor(100, [2, 8, 8, 1]) == 52_800
+
+
+def test_program_cost_reads_and_tolerates_absence():
+    c = costmodel.program_cost(FakeProgram(flops=10.0, bytes_accessed=5.0))
+    assert c == {"flops": 10.0, "bytes_accessed": 5.0}
+    assert costmodel.compiled_flops(FakeProgram(flops=10.0)) == 10.0
+    # zero/negative/missing/raising all map to None, never raise
+    assert costmodel.program_cost(FakeProgram(flops=0.0))["flops"] is None
+    assert costmodel.program_cost(FakeProgram())["flops"] is None
+    assert costmodel.program_cost(FakeProgram(raises=True))["flops"] is None
+
+
+def test_resolve_flop_basis_rules():
+    f = costmodel.resolve_flop_basis
+    # plausible own count is KEPT (a fused engine's fewer logical flops)
+    assert f(150.0, 100.0) == (150.0, "compiled")
+    # below the floor: substitute the fallback, label disclosed
+    assert f(1.0, 100.0, fallback=lambda: (200.0, "generic-engine")) \
+        == (200.0, "generic-engine")
+    # below the floor, fallback has nothing: never quote truncated
+    assert f(1.0, 100.0, fallback=lambda: (None, None)) == (None, None)
+    assert f(None, 100.0) == (None, None)
+
+
+def test_peak_lookup_and_mfu():
+    assert costmodel.peak_flops_for("TPU v4") == 275e12
+    assert costmodel.peak_flops_for("TPU v5 lite") == 197e12
+    assert costmodel.peak_flops_for("Intel Xeon") is None
+    assert costmodel.mfu(100.0, 10.0, 1, 2000.0) == 0.5
+    assert costmodel.mfu(None, 10.0, 1, 2000.0) is None
+    assert costmodel.mfu(100.0, 10.0, 1, None) is None
+
+
+def test_default_peak_env_override(monkeypatch):
+    monkeypatch.setenv("TDQ_PEAK_FLOPS", "1e12")
+    assert costmodel.default_peak() == 1e12
+    monkeypatch.setenv("TDQ_PEAK_FLOPS", "junk")
+    assert costmodel.default_peak() is None  # CPU backend, no peak
+
+
+# --------------------------------------------------------------------------- #
+# StepCostModel: gauges + the analytic-floor guard
+# --------------------------------------------------------------------------- #
+def test_step_cost_model_gauges_and_mfu():
+    reg = MetricsRegistry()
+    m = costmodel.StepCostModel(registry=reg, phase="adam", peak=1000.0)
+    out = m.observe_program(FakeProgram(flops=500.0, bytes_accessed=80.0),
+                            n_steps=10)
+    assert out == {"flops_per_step": 50.0, "bytes_per_step": 8.0,
+                   "basis": "compiled"}
+    assert m.observe_steps(10, wall_s=1.0) == pytest.approx(0.5)
+    g = reg.as_dict()["gauges"]
+    assert g["cost.flops_per_step{phase=adam}"] == 50.0
+    assert g["cost.bytes_per_step{phase=adam}"] == 8.0
+    assert g["cost.achieved_flops_per_s{phase=adam}"] == 500.0
+    assert g["cost.mfu{phase=adam}"] == pytest.approx(0.5)
+
+
+def test_step_cost_model_analytic_floor_guard():
+    """A below-floor count (cost model blinded by a custom call) is never
+    quoted: the floor substitutes as a disclosed lower bound."""
+    reg = MetricsRegistry()
+    m = costmodel.StepCostModel(registry=reg, phase="adam",
+                                floor=1000.0, peak=None)
+    out = m.observe_program(FakeProgram(flops=3.0), n_steps=1)
+    assert out["flops_per_step"] == 1000.0
+    assert out["basis"] == "analytic-floor"
+    assert reg.as_dict()["gauges"]["cost.flops_per_step{phase=adam}"] \
+        == 1000.0
+    # no cost analysis at all -> floor again (still a true lower bound)
+    out = m.observe_program(FakeProgram(), n_steps=1)
+    assert out["basis"] == "analytic-floor"
+    # unknown peak: mfu gauge never set, achieved rate still is
+    m.observe_steps(2, wall_s=1.0)
+    g = reg.as_dict()["gauges"]
+    assert "cost.mfu{phase=adam}" not in g
+    assert g["cost.achieved_flops_per_s{phase=adam}"] == 2000.0
+
+
+# --------------------------------------------------------------------------- #
+# live gauges during a CPU fit (ISSUE acceptance pin)
+# --------------------------------------------------------------------------- #
+def test_cpu_fit_publishes_live_cost_gauges(tmp_path, monkeypatch,
+                                            burgers_solver):
+    monkeypatch.setenv("TDQ_PEAK_FLOPS", "1e12")  # CPU quotes MFU via env
+    s = burgers_solver
+    reg = MetricsRegistry()
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="cost", registry=reg) as run:
+        s.fit(tf_iter=10, newton_iter=0, chunk=5,
+              telemetry=TrainingTelemetry(logger=run))
+    g = reg.as_dict()["gauges"]
+    floor = costmodel.analytic_step_floor(128, [2, 8, 1])
+    assert g["cost.flops_per_step{phase=adam}"] >= floor  # guard honored
+    assert g["cost.bytes_per_step{phase=adam}"] > 0
+    assert g["cost.achieved_flops_per_s{phase=adam}"] > 0
+    assert 0 < g["cost.mfu{phase=adam}"] < 1
+    [ev] = telemetry.read_events(d, kind="step_cost")
+    assert ev["basis"] == "compiled"
+    assert ev["flops_per_step"] == g["cost.flops_per_step{phase=adam}"]
+
+
+def test_minibatched_fit_floor_uses_batch_not_nf(tmp_path, burgers_solver):
+    """Review fix: a minibatched step executes batch_sz points' worth of
+    FLOPs — the floor must be priced on the batch or the guard would
+    discard the honest compiled count and inflate the gauges ~N_f/bsz."""
+    reg = MetricsRegistry()
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="mb", registry=reg) as run:
+        burgers_solver.fit(tf_iter=2, newton_iter=0, chunk=2, batch_sz=32,
+                           telemetry=TrainingTelemetry(logger=run))
+    [ev] = telemetry.read_events(d, kind="step_cost")
+    assert ev["basis"] == "compiled"  # kept, not floor-substituted
+    assert ev["flops_per_step"] >= costmodel.analytic_step_floor(
+        32, [2, 8, 1])
+
+
+def test_cost_model_off_leaves_registry_clean(burgers_solver):
+    reg = MetricsRegistry()
+    burgers_solver.fit(tf_iter=2, newton_iter=0, chunk=2,
+                       telemetry=TrainingTelemetry(registry=reg,
+                                                   cost_model=False))
+    assert not any(k.startswith("cost.")
+                   for k in reg.as_dict()["gauges"])
+
+
+# --------------------------------------------------------------------------- #
+# serve-time pricing
+# --------------------------------------------------------------------------- #
+def test_engine_prices_rungs_at_first_touch(burgers_solver):
+    reg = MetricsRegistry()
+    engine = burgers_solver.export_surrogate().engine(
+        min_bucket=32, max_bucket=64, registry=reg)
+    rng = np.random.RandomState(0)
+    engine.u(rng.rand(20, 2).astype(np.float32))
+    g = reg.as_dict()["gauges"]
+    per_pt = g["serving.engine.flops_per_point{bucket=32,kind=u}"]
+    # at least one forward pass worth of MACs per padded point
+    assert per_pt >= costmodel.analytic_mlp_flops([2, 8, 1], 1)
+    assert g["serving.engine.bytes_per_point{bucket=32,kind=u}"] > 0
+
+
+# --------------------------------------------------------------------------- #
+# bench.py is a thin consumer (dedupe satellite)
+# --------------------------------------------------------------------------- #
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_delegates_to_costmodel():
+    bench = _load_bench()
+    # same floor, same read, same basis labels as the live cost model
+    assert bench._analytic_step_floor(100, [8, 8]) \
+        == costmodel.analytic_step_floor(100, [2, 8, 8, 1])
+    assert bench.compiled_flops(FakeProgram(flops=7.0)) == 7.0
+    assert bench.compiled_flops(FakeProgram(raises=True)) is None
+    # a plausible compiled count keeps the byte-identical "compiled" label
+    n_f, widths = 100, [8, 8]
+    floor = bench._analytic_step_floor(n_f, widths)
+    assert bench.resolve_flop_basis(floor * 2, n_f, 8, 8, widths) \
+        == (floor * 2, "compiled")

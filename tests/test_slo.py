@@ -1,0 +1,226 @@
+"""SLO layer (telemetry.slo): objective evaluation + burn rates, the
+windowed step-time-regression objective, run-dir evaluation, the router's
+autoscale verdict, the report's SLO block, and the Prometheus text
+exposition format contract (round-trip parse)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu.fleet import FleetRouter
+from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger, SLOSet,
+                                        report, to_prometheus)
+
+
+def serving_metrics(served=95, rejected=0, timed_out=0, failed=0,
+                    p99=0.01):
+    reg = MetricsRegistry()
+    reg.counter("serving.batcher.requests").inc(served)
+    if rejected:
+        reg.counter("serving.batcher.rejected").inc(rejected)
+    if timed_out:
+        reg.counter("serving.batcher.timed_out").inc(timed_out)
+    if failed:
+        reg.counter("serving.batcher.failed").inc(failed)
+    reg.histogram("serving.batcher.latency_s").observe_many(
+        np.full(100, p99))
+    return reg
+
+
+def step_events(per_step_times, n_steps=10):
+    return [{"kind": "step_time", "n_steps": n_steps,
+             "dispatch_s": t * n_steps, "device_s": 0.0, "data_s": 0.0}
+            for t in per_step_times]
+
+
+# --------------------------------------------------------------------------- #
+# objectives
+# --------------------------------------------------------------------------- #
+def test_healthy_registry_meets_objectives():
+    v = SLOSet.default().evaluate(serving_metrics())
+    assert v["ok"] and v["breaches"] == []
+    o = v["objectives"]["serving_p99_s"]
+    assert o["ok"] is True and o["value"] == pytest.approx(0.01)
+    assert o["burn_rate"] == pytest.approx(0.04)
+    # no events -> regression objective has no data, and no-data != breach
+    assert v["objectives"]["step_time_regression"]["ok"] is None
+
+
+def test_breaches_and_burn_rates():
+    reg = serving_metrics(served=80, rejected=15, timed_out=5, p99=0.5)
+    v = SLOSet.default().evaluate(reg)
+    assert not v["ok"]
+    assert v["breaches"] == ["rejected_fraction", "serving_p99_s",
+                             "timed_out_fraction"]
+    rej = v["objectives"]["rejected_fraction"]
+    assert rej["value"] == pytest.approx(0.15)
+    assert rej["burn_rate"] == pytest.approx(3.0)   # 3x the error budget
+    # admission sheds count as rejected traffic too
+    reg2 = serving_metrics(served=95)
+    reg2.counter("fleet.admission.rejected", tenant="a",
+                 reason="rate_limit").inc(20)
+    assert "rejected_fraction" in SLOSet.default().evaluate(reg2)["breaches"]
+
+
+def test_no_traffic_is_not_a_breach():
+    v = SLOSet.default().evaluate(MetricsRegistry())
+    assert v["ok"]
+    assert all(o["ok"] is None for o in v["objectives"].values())
+
+
+def test_step_regression_windows():
+    slo = SLOSet(max_step_regression=1.5, window=3)
+    # steady run: ratio ~1, ok
+    ev = step_events([0.1] * 10)
+    v = slo.evaluate({}, ev)
+    o = v["objectives"]["step_time_regression"]
+    assert o["value"] == pytest.approx(1.0) and o["ok"] is True
+    # late 2x slowdown: trailing window vs the OPENING baseline trips
+    ev = step_events([0.1] * 5 + [0.2] * 3)
+    v = slo.evaluate({}, ev)
+    o = v["objectives"]["step_time_regression"]
+    assert o["value"] == pytest.approx(2.0) and o["ok"] is False
+    assert v["breaches"] == ["step_time_regression"]
+    # fewer events than two non-overlapping windows: no data, no verdict
+    assert slo.evaluate({}, step_events([0.1] * 5))[
+        "objectives"]["step_time_regression"]["ok"] is None
+
+
+def test_evaluate_run_dir(tmp_path):
+    d = str(tmp_path / "run")
+    reg = serving_metrics(served=50, timed_out=50)  # 50% timeouts
+    with RunLogger(d, run_id="r", registry=reg) as run:
+        for e in step_events([0.1] * 4, n_steps=10):
+            run.event("step_time", **{k: v for k, v in e.items()
+                                      if k != "kind"})
+    v = SLOSet.default().evaluate_run(d)
+    assert "timed_out_fraction" in v["breaches"]
+    # the report renders the same verdict
+    text = report(d)
+    assert "SLO: BREACH" in text and "timed_out_fraction" in text
+
+
+def test_router_autoscale_carries_slo_verdict():
+    reg = MetricsRegistry()
+    router = FleetRouter(max_loaded=1, registry=reg)
+    sig = router.autoscale_signals()
+    assert sig["slo"]["ok"] is True  # no traffic, nothing breached
+    reg.counter("serving.batcher.requests").inc(10)
+    reg.counter("serving.batcher.rejected").inc(10)
+    sig = router.autoscale_signals()
+    assert sig["slo"]["ok"] is False
+    assert "rejected_fraction" in sig["slo"]["breaches"]
+    # tunable: a custom set with a laxer budget passes the same state
+    lax = FleetRouter(max_loaded=1, registry=reg,
+                      slo=SLOSet(max_rejected_fraction=0.9))
+    assert lax.autoscale_signals()["slo"]["ok"] is True
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLOSet(window=0)
+
+
+# --------------------------------------------------------------------------- #
+# Prometheus text exposition: format contract (round-trip parse)
+# --------------------------------------------------------------------------- #
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>[^}]*)\})?\s+(?P<value>[^ ]+)$")
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Tiny exposition parser: {(name, labels-tuple): float} + TYPE map."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = tuple(sorted(LABEL_RE.findall(m.group("labels") or "")))
+        samples[(m.group("name"), labels)] = float(m.group("value"))
+    return samples, types
+
+
+def test_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("serving.batcher.requests", tenant="a").inc(7)
+    reg.counter("serving.batcher.requests", tenant="b").inc(3)
+    reg.gauge("fleet.loaded_tenants").set(2)
+    reg.gauge("cost.mfu", phase="adam").set(0.31)
+    reg.histogram("serving.batcher.latency_s").observe_many(
+        [0.01, 0.02, 0.03, 0.04])
+    reg.gauge("unset.gauge")  # never set: must be skipped, not 0
+    text = to_prometheus(reg)
+    samples, types = parse_exposition(text)
+    # counters: value under _total, one sample per label set
+    assert samples[("serving_batcher_requests_total",
+                    (("tenant", "a"),))] == 7
+    assert samples[("serving_batcher_requests_total",
+                    (("tenant", "b"),))] == 3
+    assert types["serving_batcher_requests_total"] == "counter"
+    # gauges plain, dotted -> underscores
+    assert samples[("fleet_loaded_tenants", ())] == 2
+    assert samples[("cost_mfu", (("phase", "adam"),))] == 0.31
+    assert not any(n.startswith("unset_gauge") for n, _ in samples)
+    # histograms as summaries: quantiles + sum/count (+ min/max gauges)
+    assert types["serving_batcher_latency_s"] == "summary"
+    assert samples[("serving_batcher_latency_s_count", ())] == 4
+    assert samples[("serving_batcher_latency_s_sum", ())] \
+        == pytest.approx(0.1)
+    assert samples[("serving_batcher_latency_s",
+                    (("quantile", "0.50"),))] == pytest.approx(0.025)
+    assert samples[("serving_batcher_latency_s_min", ())] == 0.01
+    assert samples[("serving_batcher_latency_s_max", ())] == 0.04
+    # accepts the plain dict form too, identically
+    assert to_prometheus(reg.as_dict()) == text
+
+
+def test_prometheus_families_are_contiguous():
+    """Review fix: every metric family must be ONE contiguous block —
+    tenant-labeled histogram instances (what the fleet's scopes produce)
+    must not split the summary family with interleaved _min/_max
+    families (strict exposition parsers reject that)."""
+    reg = MetricsRegistry()
+    for tenant in ("a", "b"):
+        reg.histogram("serving.batcher.latency_s",
+                      tenant=tenant).observe_many([0.01, 0.02])
+    text = to_prometheus(reg)
+    fams = []
+    for line in text.splitlines():
+        name = (line.split()[2] if line.startswith("# TYPE ")
+                else SAMPLE_RE.match(line).group("name"))
+        # quantile/_sum/_count samples belong to the summary family
+        for suffix in ("_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in fams:
+                name = name[:-len(suffix)]
+        if not fams or fams[-1] != name:
+            fams.append(name)
+    assert len(fams) == len(set(fams)), f"family split across blocks: {fams}"
+    # both tenants' quantiles present, once each
+    samples, types = parse_exposition(text)
+    assert types["serving_batcher_latency_s"] == "summary"
+    for tenant in ("a", "b"):
+        assert samples[("serving_batcher_latency_s",
+                        (("quantile", "0.50"), ("tenant", tenant)))] \
+            == pytest.approx(0.015)
+        assert samples[("serving_batcher_latency_s_min",
+                        (("tenant", tenant),))] == 0.01
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("fleet.admission.rejected",
+                reason='he said "no"\nback\\slash').inc()
+    text = to_prometheus(reg)
+    [line] = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert '\\"no\\"' in line and "\\n" in line and "\\\\" in line
+    samples, _ = parse_exposition(text)
+    assert list(samples.values()) == [1.0]

@@ -1,0 +1,351 @@
+"""Span tracing (telemetry.tracing): the ISSUE-pinned contracts.
+
+* disabled ⇒ one stack probe per request and bit-identical serving
+  results (the ``active_chaos()`` cheap-hook discipline);
+* a served fleet query leaves a complete admission→router→batcher→
+  engine→dispatch span tree in ``events.jsonl``;
+* structured errors (AdmissionRejected, RequestTimeout, CircuitOpenError)
+  carry a ``trace_id`` resolvable in the log;
+* ``to_perfetto`` emits valid Chrome trace-event JSON (schema contract);
+* the runlog schema bump (v1 → v2) stays read-back-compatible.
+
+All CPU, small fused=False configs — tier-1 fast.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import fleet, telemetry
+from tensordiffeq_tpu.fleet import AdmissionController, AdmissionRejected
+from tensordiffeq_tpu.serving import RequestBatcher, RequestTimeout
+from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger, Tracer,
+                                        tracing)
+from tensordiffeq_tpu.telemetry.tracing import active_tracer
+
+from test_solver import make_burgers
+
+
+@pytest.fixture(scope="module")
+def solver_and_fmodel():
+    """ONE tiny compiled solver shared by every test that only reads it
+    (surrogate export / engine queries) — the suite is compile-dominated,
+    so each avoided compile is tier-1 wall budget."""
+    domain, bcs, f_model = make_burgers(n_f=64, nx=8, nt=5)
+    s = tdq.CollocationSolverND(verbose=False)
+    s.compile([2, 8, 1], f_model, domain, bcs, fused=False)
+    return s, f_model
+
+
+def rows(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.uniform(-1, 1, n),
+                     rng.uniform(0, 1, n)], -1).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# disabled-path cost
+# --------------------------------------------------------------------------- #
+def test_tracer_off_probe_is_cheap():
+    """Mirror of test_chaos_off_hooks_are_cheap: the disabled check is a
+    list peek — 10k probes must be effectively free."""
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        assert active_tracer() is None
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_batcher_submit_is_one_probe(monkeypatch):
+    """<= 1 stack probe per request with tracing off: count the actual
+    probes one submit makes."""
+    from tensordiffeq_tpu.serving import batcher as batcher_mod
+    calls = []
+    monkeypatch.setattr(batcher_mod, "active_tracer",
+                        lambda: calls.append(1) or None)
+    b = RequestBatcher(op=lambda X: X, max_batch=1 << 20,
+                       request_timeout_s=None)
+    b.submit(rows(4))
+    assert len(calls) == 1
+
+
+def test_tracing_off_and_on_serving_bits_identical(tmp_path,
+                                                   solver_and_fmodel):
+    eng = solver_and_fmodel[0].export_surrogate().engine(
+        min_bucket=32, max_bucket=64)
+    X = rows(24)
+    b1 = RequestBatcher(eng, max_batch=256)
+    h1 = b1.submit(X)
+    b1.flush()
+    plain = h1.result()
+    with RunLogger(str(tmp_path / "run"), run_id="bits"), \
+            Tracer(trace_prefix="t"):
+        b2 = RequestBatcher(eng, max_batch=256)
+        h2 = b2.submit(X)
+        b2.flush()
+        traced = h2.result()
+    np.testing.assert_array_equal(plain, traced)
+    assert h2.trace_id is not None and h1.trace_id is None
+
+
+# --------------------------------------------------------------------------- #
+# span mechanics
+# --------------------------------------------------------------------------- #
+def test_span_tree_nesting_ids_and_error(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    with RunLogger(d, run_id="r"), Tracer(registry=reg,
+                                          trace_prefix="t") as tr:
+        with tr.span("outer", tenant="a") as root:
+            with tr.span("child.one"):
+                pass
+            with pytest.raises(RuntimeError):
+                with tr.span("child.two"):
+                    raise RuntimeError("boom")
+        # a second root starts a NEW trace
+        with tr.span("outer2"):
+            pass
+    spans = tracing.read_spans(d)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child.one"]["parent"] == root.span_id
+    assert by_name["child.one"]["trace"] == root.trace_id
+    assert by_name["child.two"]["status"] == "error"
+    assert "boom" in by_name["child.two"]["error"]
+    assert by_name["outer"]["attrs"] == {"tenant": "a"}
+    assert by_name["outer2"]["trace"] != root.trace_id
+    assert all(s["dur_s"] >= 0 for s in spans)
+    trees = tracing.span_tree(spans)
+    outer = trees[root.trace_id][0]
+    assert {c["name"] for c in outer["children"]} == {"child.one",
+                                                      "child.two"}
+    assert reg.counter("telemetry.trace.spans").value == 4
+
+
+def test_record_span_targets_a_finished_trace(tmp_path):
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="r"), Tracer(trace_prefix="t") as tr:
+        with tr.span("req") as sp:
+            tid = sp.trace_id
+        tr.record_span("late.timeout", 0.25, parent=None, trace_id=tid,
+                       status="error", error="RequestTimeout", waited_s=0.25)
+    spans = tracing.read_spans(d, trace_id=tid)
+    names = {s["name"] for s in spans}
+    assert names == {"req", "late.timeout"}
+    late = [s for s in spans if s["name"] == "late.timeout"][0]
+    assert late["status"] == "error" and late["dur_s"] == 0.25
+
+
+# --------------------------------------------------------------------------- #
+# instrumented paths
+# --------------------------------------------------------------------------- #
+def test_fleet_query_leaves_complete_span_tree(tmp_path,
+                                               solver_and_fmodel):
+    d = str(tmp_path / "run")
+    art = str(tmp_path / "artifact")
+    s, f_model = solver_and_fmodel
+    s.export_surrogate().save(art)
+    router = fleet.FleetRouter(max_loaded=1, registry=MetricsRegistry())
+    router.register("a", art, f_model=f_model, policy=fleet.TenantPolicy(
+        min_bucket=32, max_bucket=64, max_batch=64, warm_start=False))
+    with RunLogger(d, run_id="r"), Tracer(trace_prefix="t"):
+        out = router.query("a", rows(8))
+    assert out.shape == (8, 1)
+    spans = tracing.read_spans(d)
+    roots = tracing.span_tree(spans)
+    [tid] = list(roots)  # ONE trace for the whole request
+    [req] = roots[tid]
+    assert req["name"] == "fleet.request"
+
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node["children"]:
+            hit = find(c, name)
+            if hit is not None:
+                return hit
+        return None
+
+    # the admission→router→batcher→engine→dispatch chain, all one trace
+    sub = find(req, "fleet.submit")
+    assert sub is not None
+    assert find(sub, "fleet.admission") is not None
+    assert find(sub, "fleet.load") is not None
+    assert find(sub, "serving.batcher.enqueue") is not None
+    flush = find(req, "serving.batcher.flush")
+    assert flush is not None
+    run = find(flush, "serving.engine.run")
+    assert run is not None
+    dispatch = find(run, "serving.engine.dispatch")
+    assert dispatch is not None
+    assert dispatch["attrs"]["bucket"] == 32
+    assert find(run, "serving.engine.device") is not None
+    assert all(s["status"] == "ok" for s in spans)
+    # and the real request tree converts to valid Chrome trace JSON
+    pf = tracing.to_perfetto(d)
+    assert len(pf["traceEvents"]) == len(spans)
+    assert {e["ph"] for e in pf["traceEvents"]} == {"X"}
+    json.dumps(pf)  # fully serialisable
+
+
+def test_admission_rejected_carries_trace_id(tmp_path):
+    d = str(tmp_path / "run")
+    adm = AdmissionController(max_pending_points=10,
+                              registry=MetricsRegistry())
+    with RunLogger(d, run_id="r"), Tracer(trace_prefix="t"):
+        with pytest.raises(AdmissionRejected) as ei:
+            adm.admit("a", 4, 1, fleet_pending=10)
+    assert ei.value.trace_id is not None
+    spans = tracing.read_spans(d, trace_id=ei.value.trace_id)
+    [sp] = [s for s in spans if s["name"] == "fleet.admission"]
+    assert sp["status"] == "error"
+    assert "fleet_saturated" in sp["error"]
+    # untraced rejection still works and carries no id
+    with pytest.raises(AdmissionRejected) as ei2:
+        adm.admit("a", 4, 1, fleet_pending=10)
+    assert ei2.value.trace_id is None
+
+
+def test_request_timeout_carries_trace_id_and_span(tmp_path):
+    d = str(tmp_path / "run")
+
+    def op(X):  # never reached: the request expires first
+        raise AssertionError("batch must not execute")
+
+    with RunLogger(d, run_id="r"), Tracer(trace_prefix="t"):
+        b = RequestBatcher(op=op, max_batch=1 << 20,
+                           request_timeout_s=0.0)
+        h = b.submit(rows(2))
+        b.poll()  # deadline sweep
+        with pytest.raises(RequestTimeout) as ei:
+            h.result()
+    assert ei.value.trace_id == h.trace_id is not None
+    spans = tracing.read_spans(d, trace_id=h.trace_id)
+    names = {s["name"] for s in spans}
+    assert "serving.batcher.enqueue" in names
+    assert "serving.batcher.timeout" in names  # stamped into the trace
+
+
+# --------------------------------------------------------------------------- #
+# Perfetto export: Chrome trace-event schema contract
+# --------------------------------------------------------------------------- #
+def test_to_perfetto_schema_contract(tmp_path):
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="r"), Tracer(trace_prefix="t") as tr:
+        with tr.span("fleet.request", tenant="a"):
+            with tr.span("serving.engine.dispatch"):
+                pass
+        with pytest.raises(ValueError):
+            with tr.span("другой"):  # non-ascii names must still export
+                raise ValueError("x")
+    out = tracing.to_perfetto(d)
+    # file written next to the log AND returned
+    path = os.path.join(d, "trace.perfetto.json")
+    assert os.path.exists(path)
+    assert json.load(open(path)) == out
+    evs = out["traceEvents"]
+    assert len(evs) == 3
+    for e in evs:
+        assert e["ph"] == "X"                       # complete events
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["ts"], (int, float)) and e["ts"] > 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["args"]["trace_id"] and e["args"]["span_id"]
+    # two traces -> two pids; nesting -> child tid = depth 1
+    assert len({e["pid"] for e in evs}) == 2
+    child = [e for e in evs if e["name"] == "serving.engine.dispatch"][0]
+    assert child["tid"] == 1
+    err = [e for e in evs if e["name"] == "другой"][0]
+    assert err["args"]["error"].startswith("ValueError")
+
+
+# --------------------------------------------------------------------------- #
+# runlog v1 -> v2 back-compat
+# --------------------------------------------------------------------------- #
+def test_runlog_v1_reads_back_compatible(tmp_path):
+    assert telemetry.SCHEMA_VERSION == 2
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    with open(os.path.join(d, telemetry.MANIFEST_FILE), "w") as fh:
+        json.dump({"schema_version": 1, "run_id": "old",
+                   "created": 1.0, "config": {}, "environment": {}}, fh)
+    with open(os.path.join(d, telemetry.EVENTS_FILE), "w") as fh:
+        fh.write('{"v": 1, "t": 1.0, "kind": "epoch", "phase": "adam", '
+                 '"epoch": 0, "losses": {"Total Loss": 0.5}}\n')
+        fh.write('{"v": 1, "t": 2.0, "kind": "fit_end"}\n')
+    evs = telemetry.read_events(d)
+    assert [e["kind"] for e in evs] == ["epoch", "fit_end"]
+    assert all(e["v"] == 1 for e in evs)
+    s = telemetry.summarize(d)
+    assert s["losses"]["adam"]["first_total"] == 0.5
+    assert s["trace_events"] == []           # v1 logs simply have no spans
+    text = telemetry.report(d)
+    assert "old" in text and "schema v1" in text
+
+
+def test_v2_events_carry_bumped_version(tmp_path):
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="new") as run:
+        run.event("ping")
+    assert telemetry.read_events(d)[0]["v"] == 2
+
+
+def test_default_prefixes_never_collide(tmp_path):
+    """Review fix: two Tracers logging into one run dir (sequential
+    blocks, nested tracers) must not reuse trace ids — an exception's
+    trace_id has to resolve ONE trace."""
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="r"):
+        for _ in range(2):
+            with Tracer() as tr:  # default prefix both times
+                with tr.span("req"):
+                    pass
+    spans = tracing.read_spans(d)
+    assert len(spans) == 2
+    assert spans[0]["trace"] != spans[1]["trace"]
+
+
+def test_circuit_open_fast_fail_carries_trace_id(tmp_path):
+    from tensordiffeq_tpu.resilience import CircuitBreaker, CircuitOpenError
+    breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0,
+                             registry=MetricsRegistry())
+    breaker.record_failure()  # open
+    with RunLogger(str(tmp_path / "run"), run_id="r"), \
+            Tracer(trace_prefix="t"):
+        b = RequestBatcher(op=lambda X: X, breaker=breaker,
+                           max_batch=1 << 20)
+        h = b.submit(rows(2))
+        with pytest.raises(CircuitOpenError) as ei:
+            h.result()
+    assert ei.value.trace_id == h.trace_id is not None
+
+
+def test_training_diverged_carries_trace_id(tmp_path):
+    d = str(tmp_path / "run")
+    domain, bcs, f_model = make_burgers(n_f=64, nx=8, nt=5)
+    s = tdq.CollocationSolverND(verbose=False)
+    # absurd lr: the float32 loss overflows within a few steps
+    s.compile([2, 8, 1], f_model, domain, bcs, fused=False, lr=1e18)
+    with RunLogger(d, run_id="r") as run, Tracer(trace_prefix="t"):
+        with pytest.raises(telemetry.TrainingDiverged) as ei:
+            s.fit(tf_iter=20, newton_iter=0, chunk=10, telemetry=run)
+    assert ei.value.trace_id is not None
+    # the id resolves to the chunk's train.step span tree in the log
+    spans = tracing.read_spans(d, trace_id=ei.value.trace_id)
+    assert {s_["name"] for s_ in spans} >= {"train.step", "train.dispatch",
+                                            "train.device"}
+    # review fix: the chunk root is backdated to the chunk's wall start,
+    # so every child interval lies INSIDE its parent (Perfetto timeline)
+    [root] = [s_ for s_ in spans if s_["name"] == "train.step"]
+    eps = 1e-6
+    for child in spans:
+        if child.get("parent") != root["span"]:
+            continue
+        assert child["start"] >= root["start"] - eps
+        assert child["start"] + child["dur_s"] \
+            <= root["start"] + root["dur_s"] + eps
+    [div] = telemetry.read_events(d, kind="divergence")
+    assert div["trace"] == ei.value.trace_id

@@ -40,6 +40,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -49,6 +50,19 @@ from .telemetry import log_event
 
 _META = "tdq_meta.json"
 _FLAX_FILE = "state.msgpack"
+_SHARD_DIR = "shards"
+_CLUSTER_FILE = "cluster.json"
+
+#: How long multi-process saves wait on their peers' shard files before
+#: proceeding without them (a dead host must not wedge the survivors'
+#: flush; the incomplete generation fails shard-coverage validation at
+#: restore and the previous complete one is used instead).
+SYNC_TIMEOUT_S = float(os.environ.get("TDQ_CKPT_SYNC_TIMEOUT_S", "120"))
+
+# per-process save sequence number: every process of a job calls
+# save_checkpoint in lockstep (same training-loop cadence), so the
+# counter doubles as the file-based barrier's round id
+_save_seq = 0
 
 
 class TemplateMismatch(ValueError):
@@ -73,6 +87,147 @@ class CheckpointCorrupted(RuntimeError):
 
 def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, tree)
+
+
+# --------------------------------------------------------------------- #
+# Topology-portable sharded state (multi-host / elastic restore)
+#
+# A leaf that spans processes cannot be pulled to any single host
+# (``np.asarray`` on a non-fully-addressable array is illegal), so each
+# process persists ONLY its addressable shards, and the meta records the
+# global logical shape per leaf — the manifest.  Restore reassembles the
+# global host array from whatever shard files the generation holds and
+# re-shards onto the CURRENT mesh, which is how an 8-device checkpoint
+# resumes on a 4-device slice (and vice versa): the re-shard happens at
+# restore, against host arrays, never in-flight against live device state.
+# --------------------------------------------------------------------- #
+
+def _is_shard_leaf(leaf, force: bool) -> bool:
+    """Should this leaf ride the per-shard store?  Always when no single
+    process can address all of it; under ``force`` (tests, explicit
+    topology-portable saves) also when it is genuinely split over >1
+    device (a replicated leaf gathers fine and stays in the state file)."""
+    if not isinstance(leaf, jax.Array):
+        return False
+    if not leaf.is_fully_addressable:
+        return True
+    if not force or leaf.ndim == 0:
+        return False
+    segs = {tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+            for s in leaf.addressable_shards}  # slices aren't hashable <3.12
+    return len(segs) > 1
+
+
+def _segment_bounds(index, shape) -> list:
+    """Normalise a shard's index (tuple of slices) to explicit
+    ``[[start, stop], ...]`` per dimension."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _write_shards(tmp: str, sharded: dict, save_id: int) -> None:
+    """Persist this process's addressable shards of every sharded leaf
+    (one ``.npz`` + one index JSON per process; the index is written last
+    via atomic rename — it is the "this process is done" marker the
+    coordinator waits on)."""
+    proc = jax.process_index()
+    sdir = os.path.join(tmp, _SHARD_DIR)
+    os.makedirs(sdir, exist_ok=True)
+    arrays, leaves_meta = {}, {}
+    for i, leaf in sharded.items():
+        segs = []
+        for s in leaf.addressable_shards:
+            if s.replica_id != 0:
+                continue  # one writer per distinct global segment
+            key = f"l{i}_s{len(segs)}"
+            arrays[key] = np.asarray(s.data)
+            segs.append({"key": key,
+                         "bounds": _segment_bounds(s.index, leaf.shape)})
+        leaves_meta[str(i)] = {
+            "global_shape": [int(d) for d in leaf.shape],
+            "dtype": np.dtype(leaf.dtype).name,
+            "segments": segs,
+        }
+    npz_rel = os.path.join(_SHARD_DIR, f"proc{proc}.npz")
+    with open(os.path.join(tmp, npz_rel), "wb") as fh:
+        np.savez(fh, **arrays)
+    idx = {"proc": proc, "save_id": int(save_id), "file": npz_rel,
+           "leaves": leaves_meta}
+    idx_path = os.path.join(sdir, f"proc{proc}.json")
+    with open(idx_path + ".part", "w") as fh:
+        json.dump(idx, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(idx_path + ".part", idx_path)
+
+
+def _wait_for(predicate, what: str, timeout_s: float = None) -> bool:
+    """Poll ``predicate`` until true or timeout; the file-based barrier
+    primitive multi-process saves coordinate through (no collective, no
+    jax internals — a dead peer costs a bounded wait, never a hang)."""
+    timeout_s = SYNC_TIMEOUT_S if timeout_s is None else timeout_s
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    log_event("checkpoint", f"timed out after {timeout_s:.0f}s waiting for "
+              f"{what}; continuing without it", level="warning",
+              verbose=False, what=what, timeout_s=timeout_s)
+    return False
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _assemble_sharded(path: str, manifest: dict, state):
+    """Rebuild every manifest leaf as a full host array from the shard
+    files under ``path`` and graft them into ``state`` (whose manifest
+    leaves are placeholders).  Raises ``ValueError`` on incomplete
+    coverage (e.g. a flush that lost a host's shards) — the caller's
+    generation-fallback then applies."""
+    sdir = os.path.join(path, _SHARD_DIR)
+    want = {int(i): m for i, m in manifest["leaves"].items()}
+    bufs = {i: np.zeros(m["global_shape"], np.dtype(m["dtype"]))
+            for i, m in want.items()}
+    filled = {i: 0 for i in want}
+    indexes = sorted(f for f in os.listdir(sdir)
+                     if f.startswith("proc") and f.endswith(".json")) \
+        if os.path.isdir(sdir) else []
+    for rel in indexes:
+        idx = _read_json(os.path.join(sdir, rel))
+        if idx is None:
+            raise ValueError(f"unreadable shard index {rel}")
+        with np.load(os.path.join(path, idx["file"])) as npz:
+            for si, m in idx["leaves"].items():
+                i = int(si)
+                if i not in want:
+                    continue
+                for seg in m["segments"]:
+                    sl = tuple(slice(a, b) for a, b in seg["bounds"])
+                    data = npz[seg["key"]]
+                    bufs[i][sl] = data
+                    filled[i] += int(data.size)
+    for i, m in want.items():
+        total = int(np.prod(m["global_shape"])) if m["global_shape"] else 1
+        if filled[i] < total:
+            raise ValueError(
+                f"shard coverage incomplete for leaf {i} "
+                f"({filled[i]}/{total} elements; a host's shards are "
+                "missing — likely a flush after host loss)")
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    for i, buf in bufs.items():
+        leaves[i] = buf
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _payload_files(path: str) -> list:
@@ -128,7 +283,8 @@ def checkpoint_exists(path: str) -> bool:
 
 
 def save_checkpoint(path: str, state: dict, meta: dict | None = None,
-                    extra_files: dict | None = None) -> None:
+                    extra_files: dict | None = None,
+                    sharded: Optional[bool] = None) -> None:
     """Write ``state`` (a pytree dict) under directory ``path``.
 
     ``meta`` is an optional JSON-serialisable dict stored alongside (losses
@@ -154,22 +310,96 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None,
     hook (``fit(checkpoint_dir=)``) exists precisely for environments that
     kill processes at arbitrary moments; an overwrite-in-place would put
     the only resume point in the blast radius of every periodic save.
+
+    ``sharded``: topology-portable per-shard layout.  ``None`` (default)
+    auto-enables it when the job is multi-process or any leaf spans
+    devices no single process addresses; ``True`` forces it for every
+    leaf genuinely split over >1 device (how single-process tests
+    exercise the elastic-restore format); ``False`` forces the plain
+    host-gather layout (errors on non-addressable leaves).  In sharded
+    mode each process writes only its own shards; rank 0 owns the state
+    file, meta (with the global-shape manifest) and the atomic promote,
+    coordinating through bounded file waits — a dead peer costs
+    :data:`SYNC_TIMEOUT_S`, never a hang, and the resulting incomplete
+    generation fails shard-coverage validation at restore (falling back
+    to the previous complete one) instead of resurrecting partial state.
     """
     import shutil
 
+    global _save_seq
     path = os.path.abspath(path)
     tmp, old = path + ".tmp", path + ".old"
-    shutil.rmtree(tmp, ignore_errors=True)
-    os.makedirs(tmp)
-    state = _to_host(state)
+    nproc = jax.process_count()
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    if sharded is None:
+        sharded = nproc > 1 or any(
+            isinstance(l, jax.Array) and not l.is_fully_addressable
+            for l in leaves)
+    # global logical shapes — recorded BEFORE any shard substitution so
+    # restores validate the caller's template against what the state
+    # means, not how this topology happened to store it
+    leaf_shapes = [list(np.shape(l)) for l in leaves]
+    save_id, _save_seq = _save_seq, _save_seq + 1
+    shard_manifest = None
+    if sharded:
+        sharded_leaves = {i: l for i, l in enumerate(leaves)
+                          if _is_shard_leaf(l, force=True)}
+        if jax.process_index() != 0:
+            # follower: wait for rank 0 to open this round's staging dir,
+            # contribute shards, then wait for the promote (or the next
+            # round opening — rank 0 moved on without us)
+            ok = _wait_for(
+                lambda: (_read_json(os.path.join(tmp, _CLUSTER_FILE))
+                         or {}).get("save_id") == save_id,
+                f"save round {save_id} staging dir")
+            if ok:
+                _write_shards(tmp, sharded_leaves, save_id)
+                _wait_for(
+                    lambda: (_read_json(os.path.join(tmp, _CLUSTER_FILE))
+                             or {}).get("save_id") != save_id,
+                    f"save round {save_id} promote")
+            return
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _CLUSTER_FILE), "w") as fh:
+            json.dump({"nproc": nproc, "save_id": save_id}, fh)
+        _write_shards(tmp, sharded_leaves, save_id)
+        if nproc > 1:
+            sdir = os.path.join(tmp, _SHARD_DIR)
+            _wait_for(
+                lambda: all(os.path.exists(
+                    os.path.join(sdir, f"proc{p}.json"))
+                    for p in range(nproc)),
+                f"all {nproc} processes' shard files")
+        shard_manifest = {
+            "nproc": nproc,
+            "leaves": {str(i): {"global_shape": [int(d) for d in l.shape],
+                                "dtype": np.dtype(l.dtype).name}
+                       for i, l in sharded_leaves.items()}}
+        # the state file carries zero-size placeholders where the manifest
+        # leaves live; restore grafts the assembled global arrays back in
+        state = jax.tree_util.tree_unflatten(treedef, [
+            np.zeros((0,), np.dtype(l.dtype)) if i in sharded_leaves
+            else np.asarray(l) for i, l in enumerate(leaves)])
+    else:
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        state = _to_host(state)
     backend = "flax"
-    try:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(tmp, "state"), state)
-        ckptr.wait_until_finished()
-        backend = "orbax"
-    except Exception:
+    if shard_manifest is None:
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(tmp, "state"), state)
+            ckptr.wait_until_finished()
+            backend = "orbax"
+        except Exception:
+            import flax.serialization
+            with open(os.path.join(tmp, _FLAX_FILE), "wb") as fh:
+                fh.write(flax.serialization.to_bytes(state))
+    else:
+        # sharded generations always use the flax backend: orbax's own
+        # multi-process machinery would fight the file-based protocol
         import flax.serialization
         with open(os.path.join(tmp, _FLAX_FILE), "wb") as fh:
             fh.write(flax.serialization.to_bytes(state))
@@ -191,9 +421,14 @@ def save_checkpoint(path: str, state: dict, meta: dict | None = None,
                    # BEFORE any backend load, so a wrong-config restore is
                    # diagnosed as TemplateMismatch (and never triggers the
                    # corruption fallback) regardless of which backend error
-                   # a mismatched deserialisation would otherwise raise
-                   "leaf_shapes": [list(np.shape(leaf)) for leaf in
-                                   jax.tree_util.tree_leaves(state)],
+                   # a mismatched deserialisation would otherwise raise.
+                   # Sharded saves record the GLOBAL logical shapes — the
+                   # topology-portable contract a different device count
+                   # restores against.
+                   "leaf_shapes": leaf_shapes,
+                   "save_id": save_id,
+                   **({"sharded": shard_manifest}
+                      if shard_manifest is not None else {}),
                    "checksum": _digest_dir(tmp)}, fh)
         fh.flush()
         os.fsync(fh.fileno())
@@ -243,8 +478,11 @@ def verify_checkpoint(path: str) -> None:
 
 
 def _template_shape_check(saved_shapes, template) -> None:
+    # np.shape reads the GLOBAL logical shape off a jax Array without
+    # materialising it — required for multi-host templates, whose leaves
+    # may span devices this process cannot address
     t_shapes = [tuple(np.shape(leaf))
-                for leaf in jax.tree_util.tree_leaves(_to_host(template))]
+                for leaf in jax.tree_util.tree_leaves(template)]
     saved = [tuple(s) for s in saved_shapes]
     if len(saved) != len(t_shapes):
         raise TemplateMismatch(
@@ -269,7 +507,22 @@ def _restore_one(path: str, template: dict) -> tuple[dict, dict]:
         # that cannot match raises TemplateMismatch here, so a backend
         # deserialisation error below really does mean a damaged payload
         _template_shape_check(info["leaf_shapes"], template)
-    if info["backend"] == "orbax":
+    manifest = info.get("sharded")
+    if manifest is not None:
+        # topology-portable generation: the state file holds placeholders
+        # for the manifest leaves; load it against a placeholder template,
+        # then reassemble each global array from the per-process shard
+        # files (coverage-validated) — the caller re-shards onto ITS mesh
+        import flax.serialization
+        want = set(manifest["leaves"])
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        placeheld = jax.tree_util.tree_unflatten(treedef, [
+            np.zeros((0,), np.dtype(manifest["leaves"][str(i)]["dtype"]))
+            if str(i) in want else leaf for i, leaf in enumerate(leaves)])
+        with open(os.path.join(path, _FLAX_FILE), "rb") as fh:
+            state = flax.serialization.from_bytes(placeheld, fh.read())
+        state = _assemble_sharded(path, manifest, state)
+    elif info["backend"] == "orbax":
         import orbax.checkpoint as ocp
         ckptr = ocp.StandardCheckpointer()
         state = ckptr.restore(os.path.join(os.path.abspath(path), "state"),

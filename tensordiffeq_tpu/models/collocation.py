@@ -92,8 +92,15 @@ class CollocationSolverND:
           dict_adaptive/init_weights: SA contract — which loss terms carry λ
             and their initial values (``models.py:40-42``).
           g: optional λ transform for residual terms (default ``None``).
-          dist: shard collocation points (and per-point λ) over all local
-            devices (reference ``dist=True``, ``models.py:235``).
+          dist: shard collocation points (and per-point λ) over the data
+            mesh (reference ``dist=True``, ``models.py:235``).  ``True``
+            uses every global device (after
+            :func:`~tensordiffeq_tpu.parallel.initialize_multihost` that
+            spans hosts); an int takes the first that many devices; a
+            device sequence is used as given — the handle elastic
+            restores use to re-shard an 8-device checkpoint onto a
+            4-device slice (see
+            :func:`~tensordiffeq_tpu.parallel.resolve_mesh`).
           network: optional custom Flax module replacing the default MLP.
           fused: residual engine selection.  ``None`` (default) auto-uses the
             fused Taylor-propagation engine (:mod:`..ops.fused`) when
@@ -796,8 +803,8 @@ class CollocationSolverND:
 
         mesh = None
         if self.dist:
-            from ..parallel import make_mesh, shard_data_inputs
-            mesh = make_mesh()
+            from ..parallel import resolve_mesh, shard_data_inputs
+            mesh = resolve_mesh(self.dist)
             # persist the (possibly trimmed) sharded arrays so X_f and
             # per-point λ stay row-consistent across fit()/update_loss() calls
             self.X_f, self.lambdas = shard_data_inputs(self.X_f, self.lambdas,
@@ -861,6 +868,12 @@ class CollocationSolverND:
                          "lambdas": trainables["lambdas"]}
                 if opt_state is not None:
                     state["opt_state"] = opt_state
+                # sampler state: the CURRENT collocation set (adaptive
+                # resampling mutates it) rides every checkpoint, so a
+                # resume trains the points this run was actually training
+                # — and under dist it rides per-shard, re-sharding onto
+                # whatever topology the restore finds
+                state["X_f"] = self.X_f
                 min_loss = {k: float(v) for k, v in self.min_loss.items()}
                 best_epoch = dict(self.best_epoch)
                 # best-model snapshot: solver attributes only sync after a
@@ -898,6 +911,11 @@ class CollocationSolverND:
                         # until the phase returns)
                         "newton_done": int(newton_done),
                         "has_opt_state": opt_state is not None,
+                        "has_X_f": True,
+                        # the saved collocation row count: a different
+                        # topology's restore builds its template at THIS
+                        # count, then re-trims for its own mesh
+                        "n_f": int(np.shape(self.X_f)[0]),
                         # restores rebuild the opt_state template with the
                         # same clipping config, or the pytrees won't match
                         "grad_clip": grad_clip}
@@ -1195,19 +1213,27 @@ class CollocationSolverND:
         return Surrogate.from_solver(self, best_model=best_model)
 
     # ------------------------------------------------------------------ #
-    def save_checkpoint(self, path: str):
+    def save_checkpoint(self, path: str, sharded: Optional[bool] = None):
         """Checkpoint the FULL training state — params, SA λ, Adam moments,
-        loss history — under directory ``path`` (what the reference cannot
-        do: its save/load drops λ and optimizer state, SURVEY §5)."""
+        collocation set, loss history — under directory ``path`` (what the
+        reference cannot do: its save/load drops λ and optimizer state,
+        SURVEY §5).  ``sharded`` forwards to
+        :func:`tensordiffeq_tpu.checkpoint.save_checkpoint`: ``None``
+        auto-selects the topology-portable per-shard layout whenever the
+        job is multi-process (``True`` forces it — how single-process
+        tests exercise the elastic 8→4 restore format)."""
         from ..checkpoint import save_checkpoint
         state = {"params": self.params, "lambdas": self.lambdas}
         if self.opt_state is not None:
             state["opt_state"] = self.opt_state
+        state["X_f"] = self.X_f
         meta = {"losses": self.losses,
                 "min_loss": {k: float(v) for k, v in self.min_loss.items()},
                 "best_epoch": dict(self.best_epoch),
                 "newton_done": int(getattr(self, "newton_done", 0)),
                 "has_opt_state": self.opt_state is not None,
+                "has_X_f": True,
+                "n_f": int(np.shape(self.X_f)[0]),
                 "grad_clip": getattr(self, "_opt_grad_clip", None)}
         # carry the best iterate too, so predict(best_model=True) survives
         # a save/restore cycle (phase buckets tie-break before "overall",
@@ -1221,7 +1247,7 @@ class CollocationSolverND:
             state["best_params"] = self.best_model[ph]
             meta.update(has_best=True, best_phase=ph, best_loss=bl,
                         best_iter=int(self.best_epoch.get(ph, -1)))
-        save_checkpoint(path, state, meta)
+        save_checkpoint(path, state, meta, sharded=sharded)
         log_event("checkpoint", f"saved full training state -> {path}",
                   verbose=False, path=str(path),
                   epochs=len(self.losses),
@@ -1232,21 +1258,20 @@ class CollocationSolverND:
         solver.  The solver must be compiled with the same configuration so
         the state template matches.
 
-        ``dist=True`` solvers: the collocation set and per-point λ are
-        placed on the device mesh *before* building the template (a
+        ``dist`` solvers: the collocation set and per-point λ are placed
+        on the CURRENT device mesh *before* building the template (a
         checkpoint saved mid-dist-training has the trimmed row count), and
-        the restored λ are re-placed with their ``"data"`` sharding after
-        loading — training resumes sharded, no host-resident λ."""
+        the restored state — X_f, λ — is re-placed with its ``"data"``
+        sharding after loading.  The restore is where elastic re-sharding
+        happens: a checkpoint written on one topology (8 devices, 2
+        hosts) comes back as global host arrays via the per-shard
+        manifest and is re-sharded onto whatever mesh THIS solver was
+        compiled with (``dist=4``, one surviving host, …) — training
+        resumes sharded, no host-resident λ, sampler/λ/optimizer state
+        intact."""
         if not self._compiled:
             raise RuntimeError("Call compile(...) before restore_checkpoint")
         from ..checkpoint import restore_checkpoint
-        mesh = None
-        if self.dist:
-            from ..parallel import make_mesh, shard_data_inputs
-            mesh = make_mesh()
-            self.X_f, self.lambdas = shard_data_inputs(
-                self.X_f, self.lambdas, mesh=mesh)
-        template = {"params": self.params, "lambdas": self.lambdas}
         # peek at meta to know whether optimizer moments were saved (via
         # resolve_checkpoint_dir so the killed-mid-swap .old fallback the
         # restore itself applies is honoured here too)
@@ -1256,18 +1281,64 @@ class CollocationSolverND:
         with open(_os.path.join(resolve_checkpoint_dir(path),
                                 "tdq_meta.json")) as fh:
             _meta_peek = _json.load(fh)["meta"]
+        saved_nf = _meta_peek.get("n_f")
+        mesh = None
+        tmpl_lambdas = self.lambdas
+        tmpl_X = self.X_f
+        if self.dist:
+            from ..parallel import resolve_mesh, shard_data_inputs
+            mesh = resolve_mesh(self.dist)
+            if saved_nf is None:
+                # legacy checkpoint (no recorded row count): the old
+                # contract — this mesh's trim must coincide with the
+                # saved one, so place/trim before building the template
+                self.X_f, self.lambdas = shard_data_inputs(
+                    self.X_f, self.lambdas, mesh=mesh)
+                tmpl_lambdas, tmpl_X = self.lambdas, self.X_f
+            else:
+                # elastic contract: build the template at the SAVED row
+                # count (host-resident, values irrelevant — only
+                # structure/shapes feed the load); the placement AND this
+                # mesh's own trim happen AFTER the load, which is what
+                # lets an 8-device checkpoint restore onto 4 devices even
+                # when the two topologies trim N_f differently
+                n_cur = int(np.shape(self.X_f)[0])
+                base = getattr(self, "_X_f_host", None)
+                if base is None or base.shape[0] < int(saved_nf):
+                    base = np.asarray(self.domain.X_f, np.float32)
+                tmpl_X = base[: int(saved_nf)]
+
+                def _retrim(lam):
+                    if lam is not None and getattr(lam, "ndim", 0) >= 1 \
+                            and int(lam.shape[0]) == n_cur:
+                        return np.zeros((int(saved_nf),) + tuple(lam.shape[1:]),
+                                        np.float32)
+                    return lam
+                tmpl_lambdas = {k: [_retrim(l) if k == "residual" else l
+                                    for l in v]
+                                for k, v in self.lambdas.items()}
+        template = {"params": self.params, "lambdas": tmpl_lambdas}
         if _meta_peek.get("has_opt_state", False):
             opt = make_optimizer(self.lr, self.lr_weights,
                                  freeze_lambdas=getattr(self, "use_ntk", False),
                                  grad_clip=_meta_peek.get("grad_clip"))
             template["opt_state"] = opt.init(
-                {"params": self.params, "lambdas": self.lambdas})
+                {"params": self.params, "lambdas": tmpl_lambdas})
+        if _meta_peek.get("has_X_f", False):
+            template["X_f"] = tmpl_X
         if _meta_peek.get("has_best", False):
             template["best_params"] = self.params
         state, meta = restore_checkpoint(path, template)
         self.params = state["params"]
         self.lambdas = state["lambdas"]
         self.opt_state = state.get("opt_state")
+        if "X_f" in state:
+            # the checkpointed collocation set (adaptive resampling makes
+            # it trained state); host-resident here, re-sharded below
+            host_X = np.asarray(state["X_f"], np.float32)
+            self._X_f_host = host_X
+            self.X_f = host_X if mesh is not None \
+                else jnp.asarray(host_X, jnp.float32)
         # the restored moments carry this clipping config; a fit() with a
         # different grad_clip restarts them (see the stale-state check)
         self._opt_grad_clip = _meta_peek.get("grad_clip")

@@ -309,6 +309,7 @@ class DiscoveryModel:
         X_batched, idx_batched, n_batches = make_batches(
             X, batch_sz, mesh=mesh, verbose=self.verbose, permute=True)
         self._batch_idx = idx_batched  # introspection/tests
+        self._n_batches = n_batches
 
         def loss_parts(tr, X_b, u_b, cw_b):
             if fused_res is not None:
@@ -383,18 +384,29 @@ class DiscoveryModel:
         """Joint Adam training loop (reference ``models.py:381-398``).
 
         ``batch_sz`` (beyond-reference) minibatches the observation rows:
-        each step trains on one fixed PERMUTED subset of rows (observation
-        grids are meshgrid-ordered, and contiguous slabs were measured to
-        destabilise the coefficients — see ``_build``), rotating through
-        the set with a wraparound tail batch so every row trains every
-        sweep (under ``dist`` the permutation is within each device's
-        block, keeping the λ gather local).
-        Per-row SA ``col_weights`` ride with their rows — note that
-        between a row's turns its λ still drifts on decayed Adam moments
-        (standard sparse-gradient Adam; a bounded ``g=`` transform caps
-        the loss-side effect).  Batches rotate continuously across
-        ``fit`` calls and checkpoint resumes (the step counter persists
-        via the loss history)."""
+        ``tf_iter`` counts **epochs** — every batch trains each epoch
+        (``tf_iter × ceil(n/batch_sz)`` optimizer steps), the same
+        contract as the forward solver's
+        :func:`~tensordiffeq_tpu.training.fit.fit_adam`.  (Until round 8
+        it counted raw steps, which silently trained ``n_batches``×
+        fewer sweeps than the same ``tf_iter`` full-batch — the root
+        cause of the long-standing minibatch-discovery tier-1 failure:
+        400 "iterations" at 4 batches were only 100 sweeps, inside the
+        coefficient's identification noise floor.  CONVERGENCE.md
+        records the re-derived gate.)  Each step trains one fixed
+        PERMUTED subset of rows (observation grids are meshgrid-ordered,
+        and contiguous slabs were measured to destabilise the
+        coefficients — see ``_build``), rotating with a wraparound tail
+        batch so every row trains every sweep (under ``dist`` the
+        permutation is within each device's block, keeping the λ gather
+        local).  Per-row SA ``col_weights`` ride with their rows — note
+        that between a row's turns its λ still drifts on decayed Adam
+        moments (standard sparse-gradient Adam; a bounded ``g=``
+        transform caps the loss-side effect).  ``losses`` and
+        ``var_history`` record one entry per epoch (the epoch's last
+        batch), and batches rotate continuously across ``fit`` calls and
+        checkpoint resumes (the epoch counter persists via the loss
+        history)."""
         self.train_loop(tf_iter, chunk=chunk, batch_sz=batch_sz)
         return self
 
@@ -405,20 +417,26 @@ class DiscoveryModel:
         if self.verbose:
             print_screen(self, discovery_model=True)
         t0 = time.time()
+        n_batches = int(getattr(self, "_n_batches", 1))
+        total_steps = tf_iter * n_batches
+        epochs0 = len(self.losses)  # rotation resumes where the record ends
         pbar = progress_bar(tf_iter, desc="Discovery") if self.verbose else None
-        done = 0
-        while done < tf_iter:
-            n = int(min(chunk, tf_iter - done))
+        steps_done = 0
+        while steps_done < total_steps:
+            n = int(min(chunk * n_batches, total_steps - steps_done))
             self.trainables, self.opt_state, losses, var_hist = self._run_chunk(
                 self.trainables, self.opt_state,
-                jnp.asarray(len(self.losses), jnp.int32), n)
-            self.losses.extend(np.asarray(losses).tolist())
+                jnp.asarray(epochs0 * n_batches + steps_done, jnp.int32), n)
+            losses = np.asarray(losses)
             stacked = [np.asarray(v) for v in var_hist]
-            for i in range(n):
+            # one record per EPOCH (its last batch), matching fit_adam
+            for e in range(n // n_batches):
+                i = (e + 1) * n_batches - 1
+                self.losses.append(float(losses[i]))
                 self.var_history.append([float(v[i]) for v in stacked])
-            done += n
+            steps_done += n
             if pbar is not None:
-                pbar.update(n)
+                pbar.update(n // n_batches)
                 pbar.set_postfix(loss=self.losses[-1],
                                  vars=[round(v, 4) for v in self.var_history[-1]])
         if pbar is not None:

@@ -24,7 +24,8 @@ shards like everything else.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import os
+from typing import Optional, Sequence, Union
 
 import jax
 import numpy as np
@@ -34,20 +35,81 @@ from ..telemetry import log_event
 
 DATA_AXIS = "data"
 
+#: What ``compile(dist=...)`` accepts: a bool (all devices), a device count
+#: (the leading ``n`` of ``jax.devices()`` — the topology-portability lever:
+#: an 8-device checkpoint restores onto a ``dist=4`` solver and vice versa),
+#: or an explicit device sequence.
+DistSpec = Union[bool, int, Sequence]
+
 
 def make_mesh(devices: Optional[Sequence] = None,
               axis_name: str = DATA_AXIS) -> Mesh:
-    """1-D device mesh over all (local) devices — the DP topology that
-    replaces ``MirroredStrategy()`` discovery (reference ``models.py:235``)."""
+    """1-D device mesh over all global devices — the DP topology that
+    replaces ``MirroredStrategy()`` discovery (reference ``models.py:235``).
+    After :func:`initialize_multihost`, ``jax.devices()`` spans every host,
+    so the same call builds the pod-wide mesh."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis_name,))
 
 
-def initialize_multihost(**kwargs):
-    """Join a multi-host TPU pod job (DCN-coordinated).  The reference claims
+def resolve_mesh(dist: DistSpec, axis_name: str = DATA_AXIS) -> Mesh:
+    """Build the data-parallel mesh a ``dist=`` spec names (see
+    :data:`DistSpec`).  ``dist=n`` takes the first ``n`` global devices —
+    the handle the elastic-restore tests use to model an 8-device
+    checkpoint resuming on a 4-device slice without a second backend."""
+    if dist is True:
+        return make_mesh(axis_name=axis_name)
+    if isinstance(dist, bool) or dist is None:
+        raise ValueError(f"dist={dist!r} names no mesh (falsy)")
+    if isinstance(dist, (int, np.integer)):
+        devs = jax.devices()
+        if not 0 < int(dist) <= len(devs):
+            raise ValueError(
+                f"dist={int(dist)} devices requested but this backend has "
+                f"{len(devs)}")
+        return make_mesh(devs[: int(dist)], axis_name=axis_name)
+    return make_mesh(list(dist), axis_name=axis_name)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None, **kwargs):
+    """Join a multi-host job (DCN-coordinated).  The reference claims
     multi-worker support but only ever builds a single-host strategy
-    (``README.md:13`` vs ``models.py:235``); on TPU this is one call."""
-    jax.distributed.initialize(**kwargs)
+    (``README.md:13`` vs ``models.py:235``); on TPU this is one call.
+
+    On the **CPU backend** (tests, local clusters) cross-process
+    collectives need an explicit transport — XLA's default CPU client
+    rejects multi-process computations outright ("Multiprocess
+    computations aren't implemented on the CPU backend", the root cause
+    of the long-standing two-process tier-1 failure).  This entry point
+    selects the gloo TCP transport before the backend client exists, so
+    the SAME solver dist path that runs over ICI on a pod runs over
+    loopback TCP in a test cluster.  Call it instead of
+    ``jax.distributed.initialize`` and the platform difference disappears.
+    """
+    platforms = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS", "")).lower()
+    if "cpu" in platforms.split(","):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kwargs)
+
+
+def process_count() -> int:
+    """Number of processes in the job (1 when not distributed)."""
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This process's dense rank in ``[0, process_count())``."""
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """Is this the rank-0 process (the one that owns single-writer work:
+    checkpoint meta/promotion, cluster logging)?"""
+    return jax.process_index() == 0
 
 
 def data_sharding(mesh: Mesh, ndim: int = 2,

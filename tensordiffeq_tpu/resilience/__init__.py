@@ -29,6 +29,15 @@ makes every recovery path testable on CPU:
   per-request deadlines — no hung waiters) and
   :class:`~tensordiffeq_tpu.serving.InferenceEngine` (per-bucket compile
   quarantine).
+* :mod:`~tensordiffeq_tpu.resilience.cluster` — elastic multi-host
+  training: :class:`ClusterSupervisor` launches N worker processes,
+  detects dead (exit) and hung (stale chunk-boundary heartbeat) hosts,
+  drains the survivors through their preemption flush, and relaunches
+  the job on the surviving host count — the restore re-shards the last
+  good checkpoint's per-shard state onto the new topology
+  (:mod:`tensordiffeq_tpu.checkpoint`).  Chaos ``host_loss_at`` /
+  ``coordinator_timeout`` / ``dcn_stall`` faults make the whole path a
+  CPU test.
 
 Everything reports through the PR-4 telemetry layer (``rollback`` /
 ``remedy`` / ``preempt`` / ``resume`` / ``retry`` / ``breaker`` events +
@@ -38,8 +47,11 @@ and what healed.
 
 from .breaker import (CLOSED, HALF_OPEN, OPEN,  # noqa: F401
                       CircuitBreaker, CircuitOpenError)
-from .chaos import (Chaos, ChaosDeviceError, ChaosFault,  # noqa: F401
-                    ChaosServingError, active_chaos)
+from .chaos import (HOST_LOSS_EXIT_CODE, Chaos,  # noqa: F401
+                    ChaosDeviceError, ChaosFault, ChaosServingError,
+                    active_chaos)
+from .cluster import (ClusterResult, ClusterSupervisor,  # noqa: F401
+                      GenerationReport, HostLost, beat, heartbeat_file)
 from .preemption import (RESUMABLE_EXIT_CODE, Preempted,  # noqa: F401
                          PreemptionHandler, auto_resume, clear_preemption,
                          default_checkpoint_dir, handle_preemption,

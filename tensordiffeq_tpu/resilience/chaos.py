@@ -55,6 +55,26 @@ Faults and where they fire:
 * ``warmstart_fail_n`` — the first ``n`` AOT program loads during a fleet
   warm start raise (a corrupt/incompatible serialized program): the warm
   start must degrade to jit prewarm for those rungs, never fail the load.
+
+Cluster faults (the elastic multi-host failure model —
+:class:`~tensordiffeq_tpu.resilience.ClusterSupervisor`):
+
+* ``host_loss_at`` — at the first boundary at-or-past this epoch, the
+  process whose ``jax.process_index()`` equals ``host_loss_rank``
+  (default 1) hard-exits with :data:`HOST_LOSS_EXIT_CODE`: no flush, no
+  exception — exactly what a preempted pod host looks like from the
+  outside.  Survivors then fail or hang in their next collective; the
+  supervisor drains them and relaunches on the remaining host count.
+* ``coordinator_timeout`` — at this epoch the **coordinator** (rank 0)
+  stops making progress: it sleeps ``coordinator_timeout_s`` (default
+  3600 — effectively forever) at the boundary, so its heartbeat goes
+  stale while the process stays alive.  Process-liveness monitoring
+  cannot see this; the heartbeat monitor must.
+* ``dcn_stall`` — at this epoch EVERY rank sleeps ``dcn_stall_s``
+  (default 2.0) at the boundary: a transient cross-host network stall.
+  Training then continues — a supervisor whose heartbeat timeout is
+  properly above the stall must NOT declare a loss (the
+  false-positive-relaunch guard).
 """
 
 from __future__ import annotations
@@ -67,6 +87,11 @@ import numpy as np
 from ..telemetry import log_event
 
 _ENV_VAR = "TDQ_CHAOS"
+
+#: Exit status of a chaos ``host_loss_at`` kill — distinctive so cluster
+#: tests can tell the injected loss from an organic crash; the supervisor
+#: itself treats ANY non-0/non-75 exit as a lost host.
+HOST_LOSS_EXIT_CODE = 113
 
 
 class ChaosFault(RuntimeError):
@@ -103,7 +128,13 @@ class Chaos:
                  serving_fail_n: int = 0, serving_fail_rate: float = 0.0,
                  compile_fail_buckets: Sequence[int] = (),
                  fleet_evict_nth: Optional[int] = None,
-                 warmstart_fail_n: int = 0):
+                 warmstart_fail_n: int = 0,
+                 host_loss_at: Optional[int] = None,
+                 host_loss_rank: int = 1,
+                 coordinator_timeout: Optional[int] = None,
+                 coordinator_timeout_s: float = 3600.0,
+                 dcn_stall: Optional[int] = None,
+                 dcn_stall_s: float = 2.0):
         if not 0.0 <= float(serving_fail_rate) <= 1.0:
             raise ValueError(
                 f"serving_fail_rate must be in [0, 1], got {serving_fail_rate}")
@@ -120,12 +151,20 @@ class Chaos:
         self.compile_fail_buckets = tuple(int(b) for b in compile_fail_buckets)
         self.fleet_evict_nth = fleet_evict_nth
         self.warmstart_fail_n = int(warmstart_fail_n)
+        self.host_loss_at = host_loss_at
+        self.host_loss_rank = int(host_loss_rank)
+        self.coordinator_timeout = coordinator_timeout
+        self.coordinator_timeout_s = float(coordinator_timeout_s)
+        self.dcn_stall = dcn_stall
+        self.dcn_stall_s = float(dcn_stall_s)
         self._rng = np.random.RandomState(self.seed)
         # fire bookkeeping (all monotonic counters, exposed for tests/report)
         self.fired: dict[str, int] = {"nan": 0, "preempt": 0,
                                       "device_error": 0, "torn_checkpoint": 0,
                                       "serving": 0, "compile": 0,
-                                      "fleet_evict": 0, "warmstart": 0}
+                                      "fleet_evict": 0, "warmstart": 0,
+                                      "host_loss": 0, "coordinator_timeout": 0,
+                                      "dcn_stall": 0}
         self._serving_ops = 0
         self._checkpoints = 0
         self._fleet_accesses = 0
@@ -133,7 +172,9 @@ class Chaos:
         # epoch triggers fire once per *crossing*: a fired trigger stays
         # quiet until the observed boundary epoch goes backwards (a
         # rollback/resume leg re-entered), then re-arms if budget remains
-        self._armed = {"nan": True, "preempt": True, "device_error": True}
+        self._armed = {"nan": True, "preempt": True, "device_error": True,
+                       "host_loss": True, "coordinator_timeout": True,
+                       "dcn_stall": True}
         self._last_epoch: Optional[int] = None
 
     # ------------------------------------------------------------------ #
@@ -154,7 +195,8 @@ class Chaos:
             key, val = (s.strip() for s in part.split("=", 1))
             if key == "compile_fail_buckets":
                 kwargs[key] = [int(v) for v in val.split("+") if v]
-            elif key == "serving_fail_rate":
+            elif key in ("serving_fail_rate", "coordinator_timeout_s",
+                         "dcn_stall_s"):
                 kwargs[key] = float(val)
             else:
                 kwargs[key] = int(val)
@@ -172,7 +214,13 @@ class Chaos:
                              ("serving_fail_n", 0),
                              ("serving_fail_rate", 0.0),
                              ("fleet_evict_nth", None),
-                             ("warmstart_fail_n", 0)):
+                             ("warmstart_fail_n", 0),
+                             ("host_loss_at", None),
+                             ("host_loss_rank", 1),
+                             ("coordinator_timeout", None),
+                             ("coordinator_timeout_s", 3600.0),
+                             ("dcn_stall", None),
+                             ("dcn_stall_s", 2.0)):
             v = getattr(self, key)
             if v != default:
                 parts.append(f"{key}={v:g}" if isinstance(v, float)
@@ -204,6 +252,40 @@ class Chaos:
             for k in self._armed:
                 self._armed[k] = True
         self._last_epoch = epoch
+        # cluster faults first: a host that is gone (or a coordinator that
+        # is hung) never reaches this boundary's other injections
+        if self.host_loss_at is not None or self.coordinator_timeout is not None \
+                or self.dcn_stall is not None:
+            import jax
+            rank = jax.process_index()
+            if rank == self.host_loss_rank and self._trip(
+                    "host_loss", self.host_loss_at, epoch, 1):
+                log_event("chaos", f"injected host loss: rank {rank} "
+                          f"exiting at {phase} epoch {epoch}",
+                          level="warning", verbose=False, fault="host_loss",
+                          phase=phase, epoch=epoch, rank=rank)
+                import sys
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(HOST_LOSS_EXIT_CODE)
+            if rank == 0 and self._trip(
+                    "coordinator_timeout", self.coordinator_timeout, epoch, 1):
+                import time
+                log_event("chaos", "injected coordinator hang: rank 0 "
+                          f"stalling {self.coordinator_timeout_s:g}s at "
+                          f"{phase} epoch {epoch}", level="warning",
+                          verbose=False, fault="coordinator_timeout",
+                          phase=phase, epoch=epoch,
+                          stall_s=self.coordinator_timeout_s)
+                time.sleep(self.coordinator_timeout_s)
+            if self._trip("dcn_stall", self.dcn_stall, epoch, 1):
+                import time
+                log_event("chaos", f"injected DCN stall: rank {rank} "
+                          f"sleeping {self.dcn_stall_s:g}s at {phase} "
+                          f"epoch {epoch}", level="warning", verbose=False,
+                          fault="dcn_stall", phase=phase, epoch=epoch,
+                          stall_s=self.dcn_stall_s)
+                time.sleep(self.dcn_stall_s)
         if self._trip("device_error", self.device_error_epoch, epoch,
                       self.device_error_repeats):
             log_event("chaos", f"injected device error at {phase} epoch "

@@ -1,0 +1,465 @@
+"""Elastic multi-host training: supervise N worker processes, survive a
+host loss, resume on whatever topology is left.
+
+A pod job dies in ways single-process resilience cannot absorb: a host is
+preempted mid-collective (the survivors' next all-reduce hangs or errors),
+the coordinator stops scheduling (everyone blocks), DCN hiccups.  The
+:class:`ClusterSupervisor` is the control plane for that failure class:
+
+1. **launch** — spawn ``nproc`` workers (one per "host"), each with its
+   own heartbeat file, a fresh coordinator port per generation, and
+   stdout/stderr streamed to per-worker log files (a pipe would deadlock
+   a chatty worker against ``communicate`` ordering);
+2. **detect** — a worker that exits non-zero (and non-75) is a lost
+   host; a worker whose heartbeat goes stale past
+   ``heartbeat_timeout_s`` is a HUNG host (the coordinator that stops
+   scheduling, the collective that never returns — process-liveness
+   alone cannot see these).  Heartbeats are written by the training
+   loops at chunk boundaries (:func:`beat`), so they measure *forward
+   progress*, not just process existence — a background-thread
+   heartbeat would happily keep beating inside a deadlocked job;
+3. **drain** — SIGTERM the survivors (their preemption handler flushes a
+   final checkpoint and exits 75 if they are still making progress; a
+   survivor wedged in a dead collective is SIGKILLed after
+   ``grace_s``);
+4. **relaunch** — start the next generation on the surviving host count.
+   Workers are expected to re-enter through
+   :func:`~tensordiffeq_tpu.resilience.auto_resume`: the restore
+   re-shards the last good checkpoint's global state onto the new
+   topology (see :mod:`tensordiffeq_tpu.checkpoint`'s per-shard
+   manifest), so an 8-device job continues as a 4-device job.
+
+The whole path is exercisable on CPU without a pod:
+``tests/test_multihost.py`` drives a real 2-process gloo cluster with a
+chaos ``host_loss_at`` fault and asserts the relaunched 1-process run
+finishes within tolerance of an uninterrupted one.
+
+Worker contract: the supervisor runs ``argv = worker_cmd(pid, nproc,
+port)`` with env ``TDQ_HEARTBEAT_FILE`` (beat target),
+``TDQ_CLUSTER_GENERATION`` and ``TDQ_CLUSTER_NPROC``.  Exit 0 = done,
+:data:`~tensordiffeq_tpu.resilience.RESUMABLE_EXIT_CODE` (75) =
+preempted-resumable, anything else = host loss.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..telemetry import default_registry, log_event
+
+_HB_ENV = "TDQ_HEARTBEAT_FILE"
+_hb_cache = {"checked": False, "path": None}
+
+
+def heartbeat_file() -> Optional[str]:
+    """The heartbeat path this process should beat to (``$TDQ_HEARTBEAT_FILE``),
+    cached after the first look — the hot-path cost of :func:`beat` with no
+    supervisor is one dict probe."""
+    if not _hb_cache["checked"]:
+        _hb_cache["checked"] = True
+        _hb_cache["path"] = os.environ.get(_HB_ENV) or None
+    return _hb_cache["path"]
+
+
+def beat(phase: str = "", epoch: int = -1) -> None:
+    """Record forward progress (called by the training loops at every
+    chunk boundary; no-op without a supervisor).  The supervisor reads
+    the file's mtime; the tiny payload is for humans tailing the dir."""
+    path = heartbeat_file()
+    if path is None:
+        return
+    try:
+        with open(path, "w") as fh:
+            fh.write(f"{time.time():.3f} {phase} {epoch}\n")
+    except OSError:
+        pass  # a failing beat must never kill training
+
+
+def _reset_heartbeat_cache() -> None:
+    """Test helper: re-read ``TDQ_HEARTBEAT_FILE`` on the next beat."""
+    _hb_cache["checked"] = False
+    _hb_cache["path"] = None
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class HostLost(RuntimeError):
+    """The cluster exhausted its relaunch budget (or lost every host)."""
+
+
+@dataclass
+class _Worker:
+    pid: int                      # dense rank within its generation
+    proc: subprocess.Popen
+    hb_path: str
+    out_path: str
+    err_path: str
+    spawned_at: float             # monotonic (durations: join, first beat)
+    spawned_wall: float           # wall clock (staleness vs file mtimes)
+    beaten: bool = False
+    lost_reason: Optional[str] = None  # "exit" / "heartbeat" / "peer-blocked"
+    samples: list = field(default_factory=list)  # (mtime, epoch) per beat
+    _last_mtime: Optional[float] = None
+
+    def last_beat(self) -> Optional[float]:
+        try:
+            return os.path.getmtime(self.hb_path)
+        except OSError:
+            return None
+
+    def beat_age_s(self) -> float:
+        """Seconds since the last heartbeat (or spawn, when none yet) —
+        WALL clock on both sides: file mtimes are epoch time, so the
+        staleness comparison must be too (a monotonic `now` against an
+        epoch mtime is hugely negative and never goes stale)."""
+        mt = self.last_beat()
+        return time.time() - (mt if mt is not None else self.spawned_wall)
+
+    def sample(self) -> None:
+        """Record (beat time, epoch) when the heartbeat advanced — the
+        progress series behind the per-generation throughput numbers."""
+        mt = self.last_beat()
+        if mt is None or mt == self._last_mtime:
+            return
+        self._last_mtime = mt
+        try:
+            with open(self.hb_path) as fh:
+                parts = fh.read().split()
+            self.samples.append((mt, int(parts[2])))
+        except (OSError, IndexError, ValueError):
+            pass
+
+
+@dataclass
+class GenerationReport:
+    """What one launch generation did (returned inside
+    :class:`ClusterResult`; the bench ``--elastic`` payload quotes it)."""
+    generation: int
+    nproc: int
+    port: int
+    returncodes: list = field(default_factory=list)
+    lost: list = field(default_factory=list)      # (pid, reason)
+    lost_at: Optional[float] = None               # monotonic detection time
+    wall_s: float = 0.0
+    first_beat_s: Optional[float] = None          # spawn -> first heartbeat
+    epochs_per_s: Optional[float] = None          # worker 0's progress rate
+
+
+@dataclass
+class ClusterResult:
+    generations: list = field(default_factory=list)
+    relaunches: int = 0
+    hosts_lost: int = 0
+    #: host-loss detection -> first heartbeat of the relaunched
+    #: generation, one entry per relaunch: the headline recovery number
+    recovery_wall_s: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        g = self.generations[-1] if self.generations else None
+        return g is not None and g.returncodes and \
+            all(rc == 0 for rc in g.returncodes)
+
+
+class ClusterSupervisor:
+    """Launch, watch, drain, and relaunch a multi-process training job
+    (see module docstring for the failure model).
+
+    Args:
+      worker_cmd: ``f(pid, nproc, port) -> argv`` building one worker's
+        command line.  The same builder serves every generation — the
+        supervisor re-invokes it with the surviving host count.
+      nproc: initial host count.
+      workdir: heartbeat files and per-worker ``gen<g>.worker<k>.{out,err}``
+        logs land here (created if missing).
+      heartbeat_timeout_s: stale-heartbeat bound.  Must comfortably exceed
+        the slowest chunk boundary gap (compile included) — the tests use
+        the first-beat time as the yardstick.  A worker that has not
+        beaten *yet* is only timed out against this bound from its spawn,
+        so slow initialize/compile phases count too.
+      grace_s: SIGTERM -> SIGKILL window during a drain (the survivors'
+        chance to flush; a worker wedged in a dead collective won't use it).
+      max_relaunches: relaunch budget; exhaustion raises :class:`HostLost`.
+      min_hosts: refuse to relaunch below this many hosts (default 1).
+      env: extra environment for every worker (e.g. a ``TDQ_CHAOS`` spec).
+      tracer: optional :class:`~tensordiffeq_tpu.telemetry.Tracer` — emits
+        the ``cluster.launch > host.join / host.lost / reshard.restore``
+        span tree into its run log.
+      registry: metrics destination (default: the process default
+        registry) for ``cluster.launches`` / ``cluster.host_lost{reason}``
+        / ``cluster.relaunches`` counters and the ``cluster.hosts`` gauge.
+    """
+
+    def __init__(self, worker_cmd: Callable[[int, int, int], Sequence[str]],
+                 nproc: int, workdir: str, *,
+                 heartbeat_timeout_s: float = 60.0, poll_s: float = 0.2,
+                 grace_s: float = 15.0, max_relaunches: int = 2,
+                 min_hosts: int = 1, env: Optional[dict] = None,
+                 tracer=None, registry=None, verbose: bool = False):
+        self.worker_cmd = worker_cmd
+        self.nproc = int(nproc)
+        self.workdir = str(workdir)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.max_relaunches = int(max_relaunches)
+        self.min_hosts = int(min_hosts)
+        self.env = dict(env or {})
+        self.tracer = tracer
+        self.registry = registry if registry is not None else default_registry()
+        self.verbose = bool(verbose)
+        os.makedirs(self.workdir, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+    def _spawn_generation(self, gen: int, nproc: int) -> tuple:
+        port = free_port()
+        workers = []
+        for pid in range(nproc):
+            hb = os.path.join(self.workdir, f"gen{gen}.hb{pid}")
+            try:
+                os.remove(hb)
+            except OSError:
+                pass
+            out_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.out")
+            err_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.err")
+            env = dict(os.environ, **self.env)
+            env[_HB_ENV] = hb
+            env["TDQ_CLUSTER_GENERATION"] = str(gen)
+            env["TDQ_CLUSTER_NPROC"] = str(nproc)
+            argv = [str(a) for a in self.worker_cmd(pid, nproc, port)]
+            # stderr/stdout go to FILES, not pipes: the supervisor never
+            # reads them inline, so a chatty worker cannot fill a pipe and
+            # deadlock against the monitor loop
+            with open(out_p, "wb") as out_f, open(err_p, "wb") as err_f:
+                proc = subprocess.Popen(argv, stdout=out_f, stderr=err_f,
+                                        env=env, cwd=self.workdir)
+            workers.append(_Worker(pid, proc, hb, out_p, err_p,
+                                   time.monotonic(), time.time()))
+        log_event("cluster", f"generation {gen}: launched {nproc} worker"
+                  f"{'s' if nproc != 1 else ''} on port {port}",
+                  verbose=self.verbose, logger=getattr(self.tracer,
+                                                       "_logger", None),
+                  generation=gen, nproc=nproc, port=port)
+        self.registry.counter("cluster.launches").inc()
+        self.registry.gauge("cluster.hosts").set(nproc)
+        return workers, port
+
+    def _drain(self, workers) -> None:
+        """SIGTERM everything still running (the survivors' flush
+        window), then SIGKILL stragglers after ``grace_s``."""
+        for w in workers:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        for w in workers:
+            while w.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if w.proc.poll() is None:
+                w.proc.kill()
+                w.proc.wait()
+
+    def _tail(self, path: str, n: int = 2000) -> str:
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - n))
+                return fh.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    # ------------------------------------------------------------------ #
+    def run(self, timeout_s: float = 600.0) -> ClusterResult:
+        """Drive the job to completion (all workers exit 0), relaunching
+        through host losses; raises :class:`HostLost` when the relaunch
+        budget (or ``timeout_s``) runs out with the job unfinished."""
+        result = ClusterResult()
+        deadline = time.monotonic() + float(timeout_s)
+        gen, nproc = 0, self.nproc
+        t_lost: Optional[float] = None  # detection time of the last loss
+        while True:
+            launch_span = None
+            if self.tracer is not None:
+                launch_span = self.tracer.open_span(
+                    "cluster.launch", parent=None, generation=gen,
+                    nproc=nproc)
+            workers, port = self._spawn_generation(gen, nproc)
+            report = GenerationReport(gen, nproc, port)
+            t0 = time.monotonic()
+            reshard_span = None
+            if self.tracer is not None and t_lost is not None:
+                # the relaunched generation's restore + re-shard runs
+                # from its spawn until its first heartbeat
+                reshard_span = self.tracer.open_span(
+                    "reshard.restore", parent=launch_span, generation=gen,
+                    nproc=nproc)
+            lost_now = self._watch(workers, report, deadline,
+                                   launch_span, reshard_span,
+                                   t_lost, result)
+            report.wall_s = time.monotonic() - t0
+            report.returncodes = [w.proc.returncode for w in workers]
+            s = workers[0].samples
+            if len(s) >= 2 and s[-1][0] > s[0][0]:
+                report.epochs_per_s = \
+                    (s[-1][1] - s[0][1]) / (s[-1][0] - s[0][0])
+            result.generations.append(report)
+            if self.tracer is not None:
+                self.tracer.close_span(
+                    launch_span,
+                    status="ok" if not lost_now and all(
+                        rc == 0 for rc in report.returncodes) else "error")
+            if not lost_now and all(rc == 0 for rc in report.returncodes):
+                return result
+            if not lost_now and all(rc in (0, 75)
+                                    for rc in report.returncodes):
+                # externally preempted, no host lost: relaunch same size
+                pass
+            if time.monotonic() > deadline:
+                raise HostLost(
+                    f"cluster timed out after {timeout_s:.0f}s "
+                    f"(generation {gen}: rc={report.returncodes}, "
+                    f"lost={report.lost})")
+            survivors = nproc - len(report.lost)
+            if survivors < self.min_hosts:
+                raise HostLost(
+                    f"generation {gen} lost {len(report.lost)}/{nproc} "
+                    f"hosts; fewer than min_hosts={self.min_hosts} remain")
+            if result.relaunches >= self.max_relaunches:
+                why = "; ".join(
+                    f"worker {pid}: {reason}" for pid, reason in report.lost) \
+                    or f"rc={report.returncodes}"
+                raise HostLost(
+                    f"relaunch budget ({self.max_relaunches}) exhausted "
+                    f"at generation {gen} ({why}); last worker stderr:\n"
+                    + self._tail(workers[report.lost[0][0]].err_path
+                                 if report.lost else workers[0].err_path))
+            result.relaunches += 1
+            self.registry.counter("cluster.relaunches").inc()
+            # only a REAL loss arms the recovery clock (and the
+            # reshard.restore span): an all-75 preemption generation
+            # relaunches without polluting the host-loss recovery metric
+            t_lost = report.lost_at if report.lost else None
+            gen += 1
+            nproc = survivors
+            log_event("cluster", f"relaunching as generation {gen} on "
+                      f"{nproc} host{'s' if nproc != 1 else ''}",
+                      verbose=self.verbose,
+                      logger=getattr(self.tracer, "_logger", None),
+                      generation=gen, nproc=nproc, level="warning")
+
+    # ------------------------------------------------------------------ #
+    def _watch(self, workers, report: GenerationReport, deadline: float,
+               launch_span, reshard_span, t_lost, result) -> bool:
+        """Monitor one generation.  Returns True when a host was lost
+        (after draining the survivors); False when every worker exited
+        on its own (0 or 75)."""
+        join_pending = {w.pid for w in workers}
+        while True:
+            now = time.monotonic()
+            running = [w for w in workers if w.proc.poll() is None]
+            for w in workers:
+                w.sample()
+                if w.pid in join_pending and w.last_beat() is not None:
+                    join_pending.discard(w.pid)
+                    w.beaten = True
+                    if report.first_beat_s is None:
+                        report.first_beat_s = now - w.spawned_at
+                        if reshard_span is not None:
+                            # restore + re-shard done: the relaunched
+                            # job is making forward progress again
+                            self.tracer.close_span(reshard_span,
+                                                   status="ok")
+                            reshard_span = None
+                        if t_lost is not None:
+                            # host-loss detection -> resumed progress;
+                            # preemption-only relaunches pass t_lost=None
+                            # and never pollute the recovery metric
+                            result.recovery_wall_s.append(now - t_lost)
+                    if self.tracer is not None:
+                        self.tracer.record_span(
+                            "host.join", duration_s=now - w.spawned_at,
+                            parent=launch_span, pid=w.pid,
+                            generation=report.generation)
+            # 1) organic exits
+            lost = []
+            for w in workers:
+                rc = w.proc.poll()
+                if rc is not None and rc not in (0, 75) \
+                        and w.lost_reason is None:
+                    w.lost_reason = "exit"
+                    lost.append(w)
+            # 2) stale heartbeats (hung host): measured from the later of
+            # spawn and last beat, so initialize/compile time counts
+            # against the same bound as a mid-run stall
+            for w in running:
+                if w.beat_age_s() > self.heartbeat_timeout_s \
+                        and w.lost_reason is None:
+                    w.lost_reason = "heartbeat"
+                    lost.append(w)
+            # 3) watchdog: worker 0 (the coordinator) exited while peers
+            # that have never beaten still block inside
+            # jax.distributed.initialize — they would wait forever
+            w0 = workers[0]
+            if w0.proc.poll() is not None and not lost:
+                for w in running:
+                    if w is not w0 and not w.beaten \
+                            and w.lost_reason is None:
+                        w.lost_reason = "peer-blocked"
+                        lost.append(w)
+            if lost:
+                # collateral-cascade guard: when a host dies mid-collective
+                # its peers often die OF it within the same poll window.
+                # Mark at most (nproc - min_hosts) hosts lost this cycle —
+                # exits were appended before heartbeat stalls, so the most
+                # definitive failures win; drained extras count as healthy
+                # hosts for the relaunch, and a truly-dead second host is
+                # re-detected next generation.
+                cap = max(1, len(workers) - self.min_hosts) \
+                    if len(workers) > self.min_hosts else len(lost)
+                for w in lost[cap:]:
+                    w.lost_reason = None
+                lost = lost[:cap]
+                report.lost_at = now
+                for w in lost:
+                    report.lost.append((w.pid, w.lost_reason))
+                    self.registry.counter("cluster.host_lost",
+                                          reason=w.lost_reason).inc()
+                    log_event("cluster", f"generation {report.generation}: "
+                              f"host {w.pid} lost ({w.lost_reason}, "
+                              f"rc={w.proc.poll()})", level="warning",
+                              verbose=self.verbose,
+                              logger=getattr(self.tracer, "_logger", None),
+                              generation=report.generation, pid=w.pid,
+                              reason=w.lost_reason, rc=w.proc.poll())
+                    if self.tracer is not None:
+                        self.tracer.record_span(
+                            "host.lost", duration_s=0.0,
+                            parent=launch_span, status="error",
+                            pid=w.pid, reason=w.lost_reason,
+                            generation=report.generation)
+                result.hosts_lost += len(lost)
+                self._drain(workers)
+                if reshard_span is not None:
+                    self.tracer.close_span(reshard_span, status="error")
+                return True
+            if not running:
+                return False
+            if now > deadline:
+                # treat the global timeout as a drain-everything stop;
+                # run() raises HostLost with the report
+                self._drain(workers)
+                return True
+            time.sleep(self.poll_s)

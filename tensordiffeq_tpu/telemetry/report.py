@@ -136,6 +136,8 @@ def summarize(run_dir: str) -> dict:
         "retries": of_kind("retry"),
         "breaker_transitions": [e for e in of_kind("breaker")
                                 if e.get("to_state")],
+        # cluster trail (PR 8): generations, host losses, relaunches
+        "cluster_events": of_kind("cluster"),
         # fleet trail (PR 6): loads/evictions, shed traffic, warm starts
         "fleet_events": of_kind("fleet"),
         "admission_rejections": [e for e in of_kind("admission")
@@ -239,6 +241,18 @@ def report(run_dir: str, width: int = 72) -> str:
                 + (" — OVER DEADLINE" if pe.get("over_deadline") else ""))
     for rs in s["resumes"]:
         lines.append(f"RESUMED: {rs.get('message', 'resume')}")
+    for ce in s["cluster_events"]:
+        if ce.get("reason"):          # host lost
+            lines.append(
+                f"CLUSTER: host {_fmt(ce.get('pid'))} lost "
+                f"({ce.get('reason')}) in generation "
+                f"{_fmt(ce.get('generation'))}")
+        elif ce.get("nproc") is not None and "relaunch" in \
+                str(ce.get("message", "")):
+            lines.append(
+                f"CLUSTER: relaunched generation "
+                f"{_fmt(ce.get('generation'))} on {_fmt(ce.get('nproc'))} "
+                "host(s) — restore re-shards onto the surviving topology")
     if s["retries"]:
         rec = sum(1 for e in s["retries"] if e.get("recovered"))
         lines.append(f"serving retries: {len(s['retries'])} events"

@@ -36,6 +36,7 @@ import numpy as np
 import optax
 
 from ..resilience.chaos import active_chaos
+from ..resilience.cluster import beat
 from ..resilience.preemption import (Preempted, note_final_flush,
                                      preemption_requested)
 from ..telemetry import log_event
@@ -441,6 +442,10 @@ def fit_adam(loss_fn: Callable,
         prev_epochs = steps_done // n_batches
         steps_done += n
         cur_epochs = steps_done // n_batches
+        # cluster heartbeat: the host comps transfer above already fenced
+        # the device, so this beat certifies real forward progress (no-op
+        # without a supervisor — one cached dict probe)
+        beat("adam", epoch0 + cur_epochs)
         if telemetry is not None:
             n_ep = cur_epochs - prev_epochs
             rows = result.losses[-n_ep:] if n_ep else []

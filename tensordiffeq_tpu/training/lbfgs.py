@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..resilience.cluster import beat
 from ..resilience.preemption import (Preempted, note_final_flush,
                                      preemption_requested)
 from ..telemetry import log_event
@@ -175,6 +176,9 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
         history.extend(float(v) for v in values)
         prev_done = done
         done += n
+        # cluster heartbeat (no-op without a supervisor): the np.asarray
+        # above fenced the device, so this certifies forward progress
+        beat("l-bfgs", iter0 + done)
         if (callback is not None and callback_every > 0
                 and prev_done // callback_every != done // callback_every):
             # the live running best rides along so mid-run checkpoints can

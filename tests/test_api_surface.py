@@ -32,6 +32,14 @@ FLEET = ["FleetRouter", "TenantPolicy", "LoadedTenant",
          "export_fleet_artifact", "warm_start", "AOT_SUBDIR",
          "DEFAULT_KINDS"]
 
+# the elastic multi-host surface (docs/api.md Elastic/Cluster section, PR 8)
+ELASTIC_RESILIENCE = ["ClusterSupervisor", "ClusterResult",
+                      "GenerationReport", "HostLost", "beat",
+                      "heartbeat_file", "HOST_LOSS_EXIT_CODE"]
+ELASTIC_PARALLEL = ["initialize_multihost", "resolve_mesh", "make_mesh",
+                    "process_count", "process_index", "is_coordinator",
+                    "shard_data_inputs", "data_sharding", "replicated"]
+
 
 def test_migration_same_path_symbols_resolve():
     missing = [f"tdq.{mod}.{name}"
@@ -50,3 +58,12 @@ def test_fleet_surface():
     missing = [f"tdq.fleet.{n}" for n in FLEET
                if not hasattr(tdq.fleet, n)]
     assert not missing, f"fleet surface missing: {missing}"
+
+
+def test_elastic_surface():
+    from tensordiffeq_tpu import parallel, resilience
+    missing = [f"resilience.{n}" for n in ELASTIC_RESILIENCE
+               if not hasattr(resilience, n)]
+    missing += [f"parallel.{n}" for n in ELASTIC_PARALLEL
+                if not hasattr(parallel, n)]
+    assert not missing, f"elastic surface missing: {missing}"

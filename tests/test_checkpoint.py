@@ -4,6 +4,8 @@ The capability under test is exactly what the reference lacks: resuming the
 SA minimax with λ and Adam moments intact (reference save/load drops both,
 ``models.py:315-319``, SURVEY §5)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -114,9 +116,11 @@ def make_ntk_solver(n_f=128):
     return s
 
 
-def make_dist_solver(n_f=130, seed=0):
+def make_dist_solver(n_f=130, seed=0, dist=True):
     """130 points -> trimmed to 128 by the 8-device mesh placement, so the
-    test exercises the trim-then-restore row bookkeeping too."""
+    test exercises the trim-then-restore row bookkeeping too.  ``dist``
+    takes the solver's full spec (True = all devices, int = a leading
+    device-count slice — the elastic topology lever)."""
     domain = DomainND(["x", "t"], time_var="t")
     domain.add("x", [-1.0, 1.0], 16)
     domain.add("t", [0.0, 1.0], 8)
@@ -133,7 +137,7 @@ def make_dist_solver(n_f=130, seed=0):
               dict_adaptive={"residual": [True], "BCs": [False]},
               init_weights={"residual": [np.random.RandomState(0).rand(n_f, 1)],
                             "BCs": [None]},
-              dist=True)
+              dist=dist)
     return s
 
 
@@ -174,6 +178,133 @@ def test_sharded_resume_matches_uninterrupted(tmp_path, eight_devices):
 
     for l1, l2 in zip(jax_leaves(s_full.params), jax_leaves(s_b.params)):
         np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-6)
+
+
+# --------------------------------------------------------------------------- #
+# topology-portable (elastic) restore: the per-shard manifest format
+# --------------------------------------------------------------------------- #
+def _losses(s):
+    return np.array([d["Total Loss"] for d in s.losses])
+
+
+@pytest.mark.parametrize("save_dist,load_dist", [(True, 4), (4, True)],
+                         ids=["8to4", "4to8"])
+def test_topology_portable_restore_reshards(tmp_path, eight_devices,
+                                            save_dist, load_dist):
+    """A per-shard checkpoint written on one device count restores onto a
+    DIFFERENT one — 8-dev -> 4-dev (host loss) and 4-dev -> 8-dev (slice
+    grew back) — and the resumed trajectory matches the uninterrupted run
+    on the destination-independent global state."""
+    import json
+
+    s_a = make_dist_solver(dist=save_dist)
+    s_a.fit(tf_iter=10, newton_iter=0, chunk=5)
+    s_a.save_checkpoint(str(tmp_path / "ck"), sharded=True)
+    meta = json.load(open(tmp_path / "ck" / "tdq_meta.json"))
+    assert meta.get("sharded"), "per-shard layout was not written"
+    # the manifest records GLOBAL logical shapes — the topology-portable
+    # contract — and at least X_f + per-point λ ride it
+    shapes = [tuple(v["global_shape"])
+              for v in meta["sharded"]["leaves"].values()]
+    assert (128, 2) in shapes and (128, 1) in shapes
+
+    s_b = make_dist_solver(seed=1, dist=load_dist)
+    s_b.restore_checkpoint(str(tmp_path / "ck"))
+    n_dev = len(s_b.X_f.sharding.device_set)
+    assert n_dev == (4 if load_dist == 4 else 8)
+    lam = s_b.lambdas["residual"][0]
+    assert "data" in str(getattr(lam.sharding, "spec", ""))
+    assert s_b.opt_state is not None  # Adam moments crossed the re-shard
+    s_b.fit(tf_iter=10, newton_iter=0, chunk=5)
+
+    ref = make_dist_solver(dist=save_dist)
+    ref.fit(tf_iter=20, newton_iter=0, chunk=5)
+    np.testing.assert_allclose(
+        _losses(s_b), _losses(ref), rtol=1e-4,
+        err_msg=f"{save_dist}->{load_dist} re-shard diverged from the "
+        "uninterrupted trajectory")
+
+
+def test_topology_portable_restore_retrims_row_count(tmp_path,
+                                                     eight_devices):
+    """When the two topologies TRIM N_f differently (252 rows: a 4-device
+    mesh keeps all 252, an 8-device one keeps 248), the restore must
+    build its template at the SAVED row count and re-trim for its own
+    mesh after the load — regression for the hard TemplateMismatch this
+    raised before the meta's ``n_f`` record existed."""
+    s4 = make_dist_solver(n_f=252, dist=4)
+    s4.fit(tf_iter=5, newton_iter=0, chunk=5)
+    assert int(s4.X_f.shape[0]) == 252
+    s4.save_checkpoint(str(tmp_path / "ck"), sharded=True)
+
+    s8 = make_dist_solver(n_f=252, seed=1, dist=True)
+    s8.restore_checkpoint(str(tmp_path / "ck"))
+    # the 8-device mesh re-trims the restored 252-row state to 248
+    assert int(s8.X_f.shape[0]) == 248
+    lam = s8.lambdas["residual"][0]
+    assert lam.shape[0] == 248
+    assert "data" in str(getattr(lam.sharding, "spec", ""))
+    assert len(s8.losses) == 5
+    s8.fit(tf_iter=5, newton_iter=0, chunk=5)  # moments restart; trains on
+    assert np.isfinite(s8.losses[-1]["Total Loss"])
+
+
+def test_torn_shard_file_falls_back_to_previous_generation(tmp_path,
+                                                           eight_devices):
+    """A torn per-shard payload file fails the content checksum and the
+    restore falls back to the parked K=2 previous generation — same
+    protocol as the host-array layout."""
+    ck = str(tmp_path / "ck")
+    s = make_dist_solver()
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s.save_checkpoint(ck, sharded=True)        # generation A (5 epochs)
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s.save_checkpoint(ck, sharded=True)        # generation B (10 epochs)
+
+    npz = os.path.join(ck, "shards", "proc0.npz")
+    size = os.path.getsize(npz)
+    with open(npz, "r+b") as fh:               # tear generation B's shards
+        fh.truncate(max(size // 2, 1))
+        fh.seek(0)
+        fh.write(b"\xde\xad")
+
+    s2 = make_dist_solver(seed=1)
+    s2.restore_checkpoint(ck)
+    assert len(s2.losses) == 5, \
+        "torn current generation should fall back to the 5-epoch .old"
+    s2.fit(tf_iter=5, newton_iter=0, chunk=5)  # and training continues
+    assert np.isfinite(s2.losses[-1]["Total Loss"])
+
+
+def test_incomplete_shard_coverage_falls_back(tmp_path, eight_devices):
+    """A generation whose shard files are MISSING a host's contribution
+    (the survivors'-flush-after-host-loss shape: meta/checksum written
+    over the files that existed) fails coverage validation and falls back
+    to the previous complete generation."""
+    import json
+
+    from tensordiffeq_tpu import checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "ck")
+    s = make_dist_solver()
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s.save_checkpoint(ck, sharded=True)        # generation A
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    s.save_checkpoint(ck, sharded=True)        # generation B
+    # amputate generation B's shard index (its process never "finished"),
+    # then re-seal the checksum as a dead-host flush would have (digest
+    # over the files present) — coverage validation must reject it
+    os.remove(os.path.join(ck, "shards", "proc0.json"))
+    meta_p = os.path.join(ck, "tdq_meta.json")
+    meta = json.load(open(meta_p))
+    meta["checksum"] = ckpt_mod._digest_dir(ck)
+    with open(meta_p, "w") as fh:
+        json.dump(meta, fh)
+
+    s2 = make_dist_solver(seed=1)
+    s2.restore_checkpoint(ck)
+    assert len(s2.losses) == 5, \
+        "coverage-incomplete generation must not restore"
 
 
 def test_self_describing_save_load(tmp_path):

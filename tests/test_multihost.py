@@ -1,18 +1,28 @@
-"""Multi-host (multi-process) distributed training tests.
+"""Multi-host (multi-process) distributed + elastic training tests.
 
 The reference *claims* multi-worker support but only ever builds a
 single-host ``MirroredStrategy`` (SURVEY §2.2, reference ``README.md:13`` vs
 ``models.py:235``).  Here the multi-host path is exercised for real: two OS
 processes, four virtual CPU devices each, joined through
-``jax.distributed.initialize`` (the same coordination used on TPU pods over
-DCN) into one 8-device global mesh — then the FULL solver dist path runs on
-it: per-point SA λ sharded with their collocation points, Adam scan chunks,
-and the jitted L-BFGS phase.
+``parallel.initialize_multihost`` (``jax.distributed`` + the gloo CPU
+collective transport — the same coordination used on TPU pods over DCN)
+into one 8-device global mesh — then the FULL solver dist path runs on
+it: per-point SA λ sharded with their collocation points, Adam scan
+chunks, and the jitted L-BFGS phase.
 
 This is the test that caught the device-array-closure bug in
 ``training/lbfgs.py`` (closing over a globally-sharded ``X_f`` inside the
 jitted chunk — legal single-process, an error when the array spans
-non-addressable devices).
+non-addressable devices) and the missing CPU collective transport in the
+``parallel`` shim (XLA's default CPU client rejects multi-process
+computations outright; ``initialize_multihost`` now selects gloo).
+
+The elastic tests drive the full host-loss story on the same cluster:
+chaos ``host_loss_at`` hard-kills one worker mid-run, the
+:class:`~tensordiffeq_tpu.resilience.ClusterSupervisor` detects it,
+drains the hung survivor, and relaunches on ONE host — whose restore
+re-shards the 8-device checkpoint onto its 4 local devices and finishes
+the job.
 """
 
 import os
@@ -20,6 +30,7 @@ import socket
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -33,10 +44,15 @@ WORKER = textwrap.dedent("""
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(f"127.0.0.1:{port}", nproc, pid)
+    # library entry: selects the gloo CPU collective transport before the
+    # backend exists (plain jax.distributed.initialize leaves the CPU
+    # client without one and every cross-process computation fails)
+    from tensordiffeq_tpu.parallel import initialize_multihost
+    initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
     import numpy as np
 
-    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 4 * nproc \\
+        and len(jax.local_devices()) == 4
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mh_problem import build_solver
@@ -51,6 +67,21 @@ WORKER = textwrap.dedent("""
         rows = sh.index[0]
         assert not np.allclose(np.asarray(sh.data), X_orig[rows]), \\
             "redraw did not replace points"
+    elif mode == "elastic":
+        # the supervisor's worker contract: resume against TOTAL budgets,
+        # flush + exit 75 on preemption (= the supervisor's drain SIGTERM)
+        ckpt = sys.argv[5]
+        from tensordiffeq_tpu.resilience import (Preempted,
+                                                 PreemptionHandler,
+                                                 auto_resume,
+                                                 handle_preemption)
+        solver = build_solver(dist=True)
+        with PreemptionHandler(deadline_s=30):
+            try:
+                auto_resume(solver, ckpt, tf_iter=20, checkpoint_every=5,
+                            chunk=5)
+            except Preempted as e:
+                handle_preemption(e)  # exits RESUMABLE_EXIT_CODE (75)
     else:
         solver = build_solver(dist=True)
         solver.fit(tf_iter=20, newton_iter=5)
@@ -109,6 +140,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _tail(path, n=3000):
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - n))
+            return fh.read().decode("utf-8", "replace")
+    except OSError:
+        return "<no log>"
+
+
 @pytest.fixture(scope="module")
 def worker_dir(tmp_path_factory):
     d = tmp_path_factory.mktemp("mh")
@@ -117,54 +159,232 @@ def worker_dir(tmp_path_factory):
     return d
 
 
-def _run_cluster(worker_dir, nproc=2, timeout=420, mode="sa"):
-    port = _free_port()
+def _cluster_env():
     env = dict(os.environ,
                PALLAS_AXON_POOL_IPS="",  # never dial the TPU relay
                PYTHONPATH=REPO)
     env.pop("JAX_PLATFORMS", None)   # worker pins cpu itself
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker_dir / "worker.py"),
-         str(i), str(nproc), str(port), mode],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=worker_dir, env=env) for i in range(nproc)]
+    return env
+
+
+def _launch_cluster(worker_dir, nproc=2, mode="sa"):
+    """Spawn the workers (non-blocking) with output streaming to
+    per-worker LOG FILES — with pipes, a chatty worker could fill its
+    pipe buffer and deadlock against an in-order ``communicate`` loop
+    (the pre-round-8 hazard)."""
+    port = _free_port()
+    env = _cluster_env()
+    procs, errs = [], []
+    for i in range(nproc):
+        out_p = worker_dir / f"{mode}.worker{i}.out"
+        err_p = worker_dir / f"{mode}.worker{i}.err"
+        errs.append(err_p)
+        with open(out_p, "wb") as out_f, open(err_p, "wb") as err_f:
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker_dir / "worker.py"),
+                 str(i), str(nproc), str(port), mode],
+                stdout=out_f, stderr=err_f, cwd=worker_dir, env=env))
+    return procs, errs
+
+
+def _wait_cluster(worker_dir, procs, errs, timeout=420, mode="sa"):
+    """Watchdog wait: kills the whole cluster if worker 0 exits while
+    peers are still running (a worker 0 that dies at startup leaves its
+    peers blocked inside ``jax.distributed.initialize`` for its 300s
+    timeout), and never leaks a worker on any exit path."""
+    deadline = time.monotonic() + timeout
     try:
-        outs = [p.communicate(timeout=timeout) for p in procs]
-        for p, (out, err) in zip(procs, outs):
+        while any(p.poll() is None for p in procs):
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"cluster timed out after {timeout}s; worker 0 stderr:\n"
+                    + _tail(errs[0]))
+            if procs[0].poll() is not None:
+                # give the peers a short grace to exit on their own
+                grace = time.monotonic() + 5.0
+                while any(p.poll() is None for p in procs) \
+                        and time.monotonic() < grace:
+                    time.sleep(0.1)
+                if any(p.poll() is None for p in procs):
+                    raise AssertionError(
+                        f"worker 0 exited rc={procs[0].returncode} while "
+                        "peers were still running (blocked in initialize?) "
+                        "— killed the cluster; worker 0 stderr:\n"
+                        + _tail(errs[0]))
+            time.sleep(0.1)
+        for i, p in enumerate(procs):
             assert p.returncode == 0, \
-                f"worker rc={p.returncode}:\n{err[-3000:]}"
+                f"worker {i} rc={p.returncode}:\n{_tail(errs[i])}"
     finally:
-        # a worker that crashed at startup leaves its peer blocked inside
-        # jax.distributed.initialize forever — never leak it
+        # never leak a worker — a crashed peer leaves others blocked in
+        # jax.distributed.initialize forever
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    return outs[0][0]
+    return (worker_dir / f"{mode}.worker0.out").read_text()
 
 
-def test_two_process_cluster_full_solver(worker_dir, eight_devices):
-    """2 processes × 4 devices: dist SA training (Adam + L-BFGS) runs and
-    matches the single-process 8-device loss trajectory."""
-    out = _run_cluster(worker_dir)
-    line = [ln for ln in out.splitlines() if ln.startswith("LOSSES")]
-    assert line, f"worker 0 printed no losses:\n{out[-2000:]}"
-    mh_losses = np.array([float(v) for v in line[0].split()[1:]])
+def _run_cluster(worker_dir, nproc=2, timeout=420, mode="sa"):
+    procs, errs = _launch_cluster(worker_dir, nproc, mode)
+    return _wait_cluster(worker_dir, procs, errs, timeout, mode)
 
-    # same problem, same seeds, single process over the same 8-device mesh
+
+def _single_process_losses(worker_dir, **fit_kw):
+    """Same problem, same seeds, one process over the local 8-device mesh."""
     sys.path.insert(0, str(worker_dir))
     try:
         import mh_problem
         solver = mh_problem.build_solver(dist=True)
     finally:
         sys.path.pop(0)
-    solver.fit(tf_iter=20, newton_iter=5)
-    sp_losses = np.array([d["Total Loss"] for d in solver.losses])
+    solver.fit(**fit_kw)
+    return np.array([d["Total Loss"] for d in solver.losses])
+
+
+def test_two_process_cluster_full_solver(worker_dir, eight_devices):
+    """2 processes × 4 devices: dist SA training (Adam + L-BFGS) runs and
+    matches the single-process 8-device loss trajectory.  The reference
+    run computes WHILE the cluster executes (the workers spend their
+    wall in their own processes), halving the test's serial time."""
+    procs, errs = _launch_cluster(worker_dir)
+    try:
+        sp_losses = _single_process_losses(worker_dir, tf_iter=20,
+                                           newton_iter=5)
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        raise
+    out = _wait_cluster(worker_dir, procs, errs)
+    line = [ln for ln in out.splitlines() if ln.startswith("LOSSES")]
+    assert line, f"worker 0 printed no losses:\n{out[-2000:]}"
+    mh_losses = np.array([float(v) for v in line[0].split()[1:]])
 
     assert mh_losses.shape == sp_losses.shape
     np.testing.assert_allclose(mh_losses, sp_losses, rtol=1e-4,
                                err_msg="multi-process loss trajectory "
                                "diverged from single-process")
+
+
+def test_elastic_host_loss_supervisor_relaunch(worker_dir, eight_devices,
+                                               tmp_path):
+    """THE elastic acceptance path: a 2-process cluster loses host 1 to a
+    chaos ``host_loss_at`` hard-kill mid-run (after the epoch-10
+    checkpoint), the supervisor detects the exit, drains the survivor
+    (hung in its next cross-process collective), and relaunches ONE
+    worker whose ``auto_resume`` re-shards the 8-device checkpoint onto
+    its 4 local devices and finishes the 20-epoch budget.  The final
+    trajectory must match an uninterrupted single-process run — the
+    re-shard at restore is exact, so tolerance is fp-reduction-order
+    only."""
+    from tensordiffeq_tpu.resilience import ClusterSupervisor
+    from tensordiffeq_tpu.telemetry import RunLogger, read_events
+    from tensordiffeq_tpu.telemetry.tracing import Tracer
+
+    ckpt = tmp_path / "elastic_ck"
+    run_dir = tmp_path / "elastic_run"
+
+    def worker_cmd(pid, nproc, port):
+        return [sys.executable, str(worker_dir / "worker.py"),
+                str(pid), str(nproc), str(port), "elastic", str(ckpt)]
+
+    logger = RunLogger(str(run_dir), config={"test": "elastic"})
+    with logger, Tracer(logger=logger) as tracer:
+        sup = ClusterSupervisor(
+            worker_cmd, nproc=2, workdir=str(tmp_path / "sup"),
+            heartbeat_timeout_s=180,  # compile + host contention ride
+            grace_s=5.0,              # survivor is wedged; don't linger
+            max_relaunches=2, tracer=tracer,
+            env=dict(_cluster_env(), TDQ_CHAOS="host_loss_at=10"))
+        # overlap: the uninterrupted reference trajectory computes in
+        # THIS process while the cluster runs in its own (the supervisor
+        # thread only polls files/processes — no GIL contention with the
+        # fit's XLA execution)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(1) as ex:
+            fut = ex.submit(sup.run, 400)
+            sp = _single_process_losses(worker_dir, tf_iter=20,
+                                        newton_iter=0, chunk=5)
+            result = fut.result()
+
+    assert result.ok, result
+    assert result.hosts_lost == 1 and result.relaunches == 1, result
+    gens = result.generations
+    assert [g.nproc for g in gens] == [2, 1]
+    assert gens[0].lost == [(1, "exit")]
+    assert len(result.recovery_wall_s) == 1 \
+        and result.recovery_wall_s[0] > 0
+    # generation 2's single worker finished the full budget and printed
+    # the stitched trajectory: epochs 0-10 trained on 8 devices (2 hosts),
+    # 10-20 trained on 4 (1 host) after the re-shard restore
+    out = _tail(os.path.join(str(tmp_path / "sup"), "gen1.worker0.out"),
+                n=100_000)
+    line = [ln for ln in out.splitlines() if ln.startswith("LOSSES")]
+    assert line, f"relaunched worker printed no losses:\n{out[-2000:]}"
+    mh = np.array([float(v) for v in line[0].split()[1:]])
+    assert mh.shape == (20,) and np.all(np.isfinite(mh))
+
+    np.testing.assert_allclose(mh, sp, rtol=1e-4,
+                               err_msg="post-host-loss resumed trajectory "
+                               "diverged from the uninterrupted run")
+
+    # the span story landed in the run log: cluster.launch roots with
+    # host.join children, the host.lost marker, and reshard.restore
+    # covering relaunch -> first heartbeat
+    spans = [e for e in read_events(str(run_dir)) if e.get("kind") == "trace"]
+    names = [s["name"] for s in spans]
+    assert names.count("cluster.launch") == 2
+    assert "host.lost" in names and "reshard.restore" in names
+    lost = next(s for s in spans if s["name"] == "host.lost")
+    assert lost["attrs"]["pid"] == 1 and lost["status"] == "error"
+    reshard = next(s for s in spans if s["name"] == "reshard.restore")
+    assert reshard["status"] == "ok"
+
+
+def test_cluster_heartbeat_chaos_off_bit_identity(eight_devices, tmp_path,
+                                                  monkeypatch):
+    """The elastic wiring (chunk-boundary heartbeats) must not perturb a
+    plain dist fit: with TDQ_HEARTBEAT_FILE set and chaos off, the loss
+    trajectory is BIT-identical to an unwired run — the beat lives
+    entirely outside the compiled step."""
+    import jax
+
+    from tensordiffeq_tpu import CollocationSolverND, DomainND
+    from tensordiffeq_tpu.resilience import cluster as rcluster
+
+    def build():
+        domain = DomainND(["x", "t"], time_var="t")
+        domain.add("x", [-1.0, 1.0], 16)
+        domain.add("t", [0.0, 1.0], 8)
+        domain.generate_collocation_points(256, seed=3)
+        from tensordiffeq_tpu import grad
+
+        def f_model(u, x, t):
+            return grad(u, "t")(x, t) - 0.05 * grad(grad(u, "x"), "x")(x, t)
+
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 8, 1], f_model, domain, [], dist=True, fused=False)
+        return s
+
+    plain = build()
+    plain.fit(tf_iter=12, newton_iter=0, chunk=4)
+
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("TDQ_HEARTBEAT_FILE", str(hb))
+    rcluster._reset_heartbeat_cache()
+    try:
+        beaten = build()
+        beaten.fit(tf_iter=12, newton_iter=0, chunk=4)
+    finally:
+        monkeypatch.delenv("TDQ_HEARTBEAT_FILE")
+        rcluster._reset_heartbeat_cache()
+
+    assert hb.exists(), "chunk boundaries did not beat"
+    a = np.array([d["Total Loss"] for d in plain.losses])
+    b = np.array([d["Total Loss"] for d in beaten.losses])
+    np.testing.assert_array_equal(a, b)
 
 
 @pytest.mark.slow

@@ -82,6 +82,14 @@ def test_chaos_spec_roundtrip_and_scoping():
     assert c.serving_fail_rate == 0.25 and c.seed == 3
     assert c.compile_fail_buckets == (64, 128)
     assert Chaos.from_spec(c.spec()).spec() == c.spec()
+    # cluster faults (PR 8) round-trip too, floats included
+    c2 = Chaos.from_spec("host_loss_at=10,host_loss_rank=0,"
+                         "coordinator_timeout=7,coordinator_timeout_s=12.5,"
+                         "dcn_stall=5,dcn_stall_s=0.25")
+    assert c2.host_loss_at == 10 and c2.host_loss_rank == 0
+    assert c2.coordinator_timeout == 7 and c2.coordinator_timeout_s == 12.5
+    assert c2.dcn_stall == 5 and c2.dcn_stall_s == 0.25
+    assert Chaos.from_spec(c2.spec()).spec() == c2.spec()
     assert active_chaos() is None
     with c:
         assert active_chaos() is c
@@ -115,6 +123,75 @@ def test_chaos_off_training_is_bit_identical():
     np.testing.assert_array_equal(
         np.asarray(plain.lambdas["residual"][0]),
         np.asarray(sup.lambdas["residual"][0]))
+
+
+def test_supervisor_detects_hung_host_via_stale_heartbeat(tmp_path):
+    """A host whose PROCESS lives but whose heartbeat goes stale (the
+    wedged-coordinator shape) must be declared lost and the job must
+    relaunch on the survivors.  Fast fake workers (no jax): worker 0 of
+    the 2-host generation beats once then hangs; regression for the
+    wall-vs-monotonic clock bug where a worker that had beaten once
+    could never go stale (mtime is epoch time; the monotonic `now` made
+    the age hugely negative)."""
+    import subprocess  # noqa: F401 — workers are plain python -c
+    import sys
+
+    from tensordiffeq_tpu.resilience import ClusterSupervisor
+    from tensordiffeq_tpu.telemetry import MetricsRegistry
+
+    script = tmp_path / "fake_worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "pid, nproc = int(sys.argv[1]), int(sys.argv[2])\n"
+        "hb = os.environ['TDQ_HEARTBEAT_FILE']\n"
+        "def beat(e):\n"
+        "    with open(hb, 'w') as fh:\n"
+        "        fh.write(f'{time.time():.3f} fake {e}\\n')\n"
+        "beat(0)\n"
+        "if nproc == 2 and pid == 0:\n"
+        "    time.sleep(60)  # hung: beats stop, the process lives\n"
+        "for e in range(1, 4):\n"
+        "    time.sleep(0.05); beat(e)\n"
+    )
+
+    def worker_cmd(pid, nproc, port):
+        return [sys.executable, str(script), str(pid), str(nproc)]
+
+    reg = MetricsRegistry()
+    sup = ClusterSupervisor(worker_cmd, nproc=2, workdir=str(tmp_path / "w"),
+                            heartbeat_timeout_s=1.0, poll_s=0.05,
+                            grace_s=2.0, max_relaunches=1, registry=reg)
+    result = sup.run(timeout_s=30)
+    assert result.ok, result
+    assert result.hosts_lost == 1 and result.relaunches == 1
+    assert result.generations[0].lost == [(0, "heartbeat")]
+    assert result.generations[1].nproc == 1
+    assert len(result.recovery_wall_s) == 1
+    counters = reg.as_dict()["counters"]
+    assert counters.get("cluster.host_lost{reason=heartbeat}") == 1
+
+
+def test_dcn_stall_and_coordinator_timeout_are_pure_stalls():
+    """The transient cluster faults (``dcn_stall`` everywhere,
+    ``coordinator_timeout`` on rank 0 — which a single process is) sleep
+    at the boundary and training continues BIT-identically: they perturb
+    the timeline a heartbeat monitor watches, never the numerics."""
+    import time as _time
+
+    plain = make_solver()
+    plain.fit(tf_iter=20, newton_iter=0, chunk=10)
+
+    stalled = make_solver()
+    c = Chaos(dcn_stall=10, dcn_stall_s=0.2,
+              coordinator_timeout=10, coordinator_timeout_s=0.2)
+    t0 = _time.monotonic()
+    with c:
+        stalled.fit(tf_iter=20, newton_iter=0, chunk=10)
+    assert c.fired["dcn_stall"] == 1
+    assert c.fired["coordinator_timeout"] == 1
+    assert _time.monotonic() - t0 >= 0.4  # both stalls actually slept
+    for a, b in zip(leaves(plain.params), leaves(stalled.params)):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_chaos_off_hooks_are_cheap():

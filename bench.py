@@ -94,8 +94,10 @@ def log(*a):
 _UNSET = object()
 
 # engine-artifact names -> CollocationSolverND.compile(fused=...) values
+# ("fused-minimax" maps to fused=True: the minimax loss engine auto-adopts
+# on top of any fused residual engine — compile(minimax=None) default)
 _ENGINE_MAP = {"pallas": "pallas", "fused-pallas": "pallas",
-               "fused": True, "fused-xla": True,
+               "fused": True, "fused-xla": True, "fused-minimax": True,
                "generic": False, "autotune": "autotune"}
 
 
@@ -136,28 +138,33 @@ def engine_hint(default="autotune"):
 
 
 def precision_hint():
-    """``(fused, fused_dtype)`` for the headline run, from the promoted
-    ``BENCH_TPU_precision.json``: when a mixed-precision fused config
-    (bf16 matmul operands, f32 accumulation) is the measured-best on
-    chip, the default-mode throughput adopts it — the PERF.md roofline
+    """``(fused, fused_dtype, minimax)`` for the headline run, from the
+    promoted ``BENCH_TPU_precision.json``: when a mixed-precision fused
+    config (bf16 matmul operands, f32 accumulation) is the measured-best
+    on chip, the default-mode throughput adopts it — the PERF.md roofline
     identifies removing the six-pass f32 multiplier as THE lever past
     ~9% MFU, and bf16 SA training is accuracy-validated end-to-end
     (``runs/bf16_accuracy.json``, CONVERGENCE.md).  The full-precision
     net-dtype config (``bf16-matmul``) is never hinted — measured to FAIL
     end-to-end accuracy (rel-L2 3.7x worse than f32 at equal budget,
     ``runs/bf16_net_accuracy.json``): only the fused
-    engines carry the end-to-end accuracy evidence.  ``BENCH_DTYPE=f32``
-    disables the hint, and an explicit ``BENCH_ENGINE`` override wins
-    outright (engine_hint's contract) — no dtype hint rides along with
-    it.  Returns ``(None, None)`` when no hint applies."""
+    engines carry the end-to-end accuracy evidence.  The ``minimax``
+    element pins the loss-engine flavor the winning row was MEASURED
+    with (the bf16-taylor/bf16-pallas rows run ``minimax=False``,
+    bf16-minimax runs the fused minimax step) so the replayed headline
+    config is the measured one, not a different auto-adopted engine.
+    ``BENCH_DTYPE=f32`` disables the hint, and an explicit
+    ``BENCH_ENGINE`` override wins outright (engine_hint's contract) —
+    no dtype hint rides along with it.  Returns ``(None, None, None)``
+    when no hint applies."""
     if os.environ.get("BENCH_DTYPE", "").lower() in ("off", "f32",
                                                      "float32"):
-        return None, None
+        return None, None, None
     if os.environ.get("BENCH_ENGINE"):
-        return None, None
+        return None, None, None
     import jax
     if jax.default_backend() != "tpu":
-        return None, None
+        return None, None, None
     try:
         # load_cached_tpu applies the artifact-safety guards (last JSON
         # line, backend=="tpu", no sentinel backend_note) — same reader
@@ -172,28 +179,33 @@ def precision_hint():
         # out bf16-pallas by 6% and the old `best == ...` chain returned
         # no hint at all, leaving the headline on f32-pallas at HALF the
         # validated mixed-precision throughput
-        validated = {k: ok[k] for k in ("bf16-pallas", "bf16-taylor")
+        validated = {k: ok[k] for k in ("bf16-pallas", "bf16-taylor",
+                                        "bf16-minimax")
                      if k in ok}
         if not validated:
-            return None, None
+            return None, None, None
         best = max(validated, key=validated.get)
         # only adopt when it actually beats the f32 rows from the same sweep
         f32_best = max((v for k, v in ok.items() if k.startswith("f32")),
                        default=None)
         if f32_best is not None and validated[best] <= f32_best:
-            return None, None
-        hint = (("pallas", "bfloat16") if best == "bf16-pallas"
-                else (True, "bfloat16"))
+            return None, None, None
+        # the minimax element replays the loss engine the row MEASURED:
+        # bf16-taylor/bf16-pallas ran minimax=False, bf16-minimax=True
+        hint = (("pallas", "bfloat16", False) if best == "bf16-pallas"
+                else (True, "bfloat16", True) if best == "bf16-minimax"
+                else (True, "bfloat16", False))
         log(f"[precision] measured-best config {best!r} -> "
-            f"fused={hint[0]!r}, fused_dtype={hint[1]!r} "
-            f"(set BENCH_DTYPE=f32 to disable)")
+            f"fused={hint[0]!r}, fused_dtype={hint[1]!r}, "
+            f"minimax={hint[2]!r} (set BENCH_DTYPE=f32 to disable)")
         return hint
     except Exception:
-        return None, None
+        return None, None, None
 
 
 def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
-                 precision=_UNSET, fused_dtype=None, remat=False):
+                 precision=_UNSET, fused_dtype=None, remat=False,
+                 minimax=None):
     import tensordiffeq_tpu as tdq
     from tensordiffeq_tpu import IC, CollocationSolverND, DomainND, grad, periodicBC
 
@@ -235,7 +247,8 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
         dict_adaptive={"residual": [True], "BCs": [True, False]},
         init_weights={"residual": [rng.rand(n_f, 1)],
                       "BCs": [100.0 * rng.rand(nx, 1), None]},
-        fused=fused, network=network, fused_dtype=fused_dtype, remat=remat)
+        fused=fused, network=network, fused_dtype=fused_dtype, remat=remat,
+        minimax=minimax)
     return solver
 
 
@@ -441,12 +454,12 @@ def build_solver_fallback(n_f, nx, nt, widths, fused, tag, grad_probe=False):
 
 
 def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune",
-                         remat=False, fused_dtype=None):
+                         remat=False, fused_dtype=None, minimax=None):
     import jax
 
-    def prep(fused_arg, fd=fused_dtype):
+    def prep(fused_arg, fd=fused_dtype, mm=minimax):
         solver = build_solver(n_f, nx, nt, widths, fused=fused_arg,
-                              remat=remat, fused_dtype=fd)
+                              remat=remat, fused_dtype=fd, minimax=mm)
         t0 = time.time()
         step, trainables, opt_state = aot_compile_sa_step(solver)
         flops_per_step = compiled_flops(step)
@@ -469,9 +482,10 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune",
         log(f"[jax] hinted engine fused={fused!r} fused_dtype="
             f"{fused_dtype!r} failed ({type(e).__name__}: {e}); "
             f"falling back to full-precision autotune")
-        # clear the dtype too: it may itself be what failed to lower
+        # clear the dtype (and the minimax pin) too: either may itself be
+        # what failed to lower
         solver, step, trainables, opt_state, loss, flops_per_step = \
-            prep("autotune", None)
+            prep("autotune", None, None)
         engine_used = "'autotune' (hint failed)"
         fused_dtype = None
 
@@ -499,7 +513,10 @@ def bench_jax_throughput(n_f, nx, nt, widths, n_steps, fused="autotune",
             "mfu": mfu,
             "device_kind": dev_kind, "backend": jax.default_backend(),
             "engine": engine_used + ("+remat" if remat else "")
-            + (f"+{fused_dtype}" if fused_dtype else ""),
+            + (f"+{fused_dtype}" if fused_dtype else "")
+            # disclose the ACTUAL loss engine (auto-adoption included)
+            + (f"+minimax-{solver._minimax_kind}"
+               if getattr(solver, "_minimax_kind", None) else ""),
             "loss": float(loss)}
 
 
@@ -614,16 +631,21 @@ def bench_engines(n_f, nx, nt, widths, n_steps):
     # the engine solvers are built WITHOUT dist=True — the step runs on one
     # device regardless of how many the host has, so per-chip == measured
     n_chips = 1
-    candidates = [("generic", False), ("fused-xla", True)]
+    # legacy rows pin minimax=False so they keep measuring the residual
+    # ENGINE alone (comparable with promoted artifacts); the fused-minimax
+    # row is the whole-loss fusion on top of the best available engine
+    candidates = [("generic", False, False), ("fused-xla", True, False)]
     from tensordiffeq_tpu.ops import pallas_taylor
     if pallas_taylor.available():
-        candidates.append(("fused-pallas", "pallas"))
+        candidates.append(("fused-pallas", "pallas", False))
     else:
         log("[engines] pallas excluded (no real TPU backend); it runs only "
             "in interpret mode here")
-    for engine, fused in candidates:
+    candidates.append(("fused-minimax", True, True))
+    for engine, fused, minimax in candidates:
         try:
-            solver = build_solver(n_f, nx, nt, widths, fused=fused)
+            solver = build_solver(n_f, nx, nt, widths, fused=fused,
+                                  minimax=minimax)
             t0 = time.time()
             step, trainables, opt_state = aot_compile_sa_step(solver)
             trainables, opt_state, loss = step(trainables, opt_state, solver.X_f)
@@ -663,14 +685,25 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
         # mixed-precision fused Taylor engine: bf16 matmul operands with
         # f32 accumulation inside the derivative propagation (the network
         # itself stays f32) — the MXU-native path for the PINN hot loop
-        "bf16-taylor": {"fused": True, "fused_dtype": "bfloat16"},
+        # (minimax pinned OFF so the row keeps measuring the residual
+        # engine alone, comparable with promoted artifacts)
+        "bf16-taylor": {"fused": True, "fused_dtype": "bfloat16",
+                        "minimax": False},
+        # fused-minimax rows: the whole loss term — residual + SA-λ
+        # weighting + reduction + every cotangent — as ONE fusion
+        # (ops/pallas_minimax; the VMEM-resident kernel on real TPU, the
+        # fused-XLA jaxpr elsewhere), at f32 and at bf16-matmul/f32-accum
+        "f32-minimax": {"fused": True, "minimax": True},
+        "bf16-minimax": {"fused": True, "fused_dtype": "bfloat16",
+                         "minimax": True},
     }
     from tensordiffeq_tpu.ops import pallas_taylor
     if pallas_taylor.available():
         # the VMEM-resident kernel with bf16 matmul operands — candidate
         # fastest config on real TPU (pallas won the f32 engine race)
         configs["bf16-pallas"] = {"fused": "pallas",
-                                  "fused_dtype": "bfloat16"}
+                                  "fused_dtype": "bfloat16",
+                                  "minimax": False}
     else:
         log("[precision] bf16-pallas excluded (no real TPU backend)")
     # single-device solvers (no dist=True): per-chip == measured
@@ -718,6 +751,84 @@ def bench_precision(n_f, nx, nt, widths, n_steps):
             out[name] = {"error": f"{type(e).__name__}: {e}"}
             log(f"[precision] {name} FAILED: {out[name]['error']}")
     return out
+
+
+# --------------------------------------------------------------------------- #
+# --minimax: the fused minimax step vs the unfused fused-XLA path
+# --------------------------------------------------------------------------- #
+def bench_minimax(n_f, nx, nt, widths, n_steps):
+    """Price the fused minimax STEP — residual + SA-λ-weighted loss +
+    parameter cotangents + the per-point λ-ascent direction as ONE fusion
+    (:mod:`tensordiffeq_tpu.ops.pallas_minimax`) — against the unfused
+    path: the same fused-XLA residual engine with the loss assembled
+    outside and reverse-mode AD transposing the whole chain
+    (``compile(minimax=False)``).  Meaningful on CPU too (the acceptance
+    bar is a measured step-time reduction there: the fusion owns its data
+    layout, so the batched channel matmul's pathological AD transpose is
+    replaced by the flat-GEMM custom VJP); on real TPU the engine lowers
+    to the VMEM-resident pallas kernel and each arm quotes its own MFU."""
+    import jax
+
+    n_chips = 1  # single-device solvers: per-chip == measured
+    arms = {}
+    for name, minimax in (("unfused", False), ("minimax", True)):
+        try:
+            solver = build_solver(n_f, nx, nt, widths, fused=True,
+                                  minimax=minimax)
+            t0 = time.time()
+            step, trainables, opt_state = aot_compile_sa_step(solver)
+            flops_per_step = compiled_flops(step)
+            trainables, opt_state, loss = step(trainables, opt_state,
+                                               solver.X_f)
+            jax.block_until_ready(loss)
+            compile_t = time.time() - t0
+            t0 = time.time()
+            for _ in range(n_steps):
+                trainables, opt_state, loss = step(trainables, opt_state,
+                                                   solver.X_f)
+            t_disp = time.time()
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            _record_step_split(n_steps, t_disp - t0, dt - (t_disp - t0))
+            _, flops_basis, mfu = mfu_for(
+                flops_per_step, n_steps / dt, n_chips, n_f, nx, nt, widths)
+            arms[name] = {
+                "engine": (f"fused-minimax-{solver._minimax_kind}"
+                           if minimax else "fused-xla"),
+                "step_time_s": dt / n_steps,
+                "pts_per_sec": n_f * n_steps / dt / n_chips,
+                "loss": float(loss),
+                "mfu": (round(mfu, 4) if mfu is not None else None),
+                "flops_basis": flops_basis,
+            }
+            log(f"[minimax] {name} ({arms[name]['engine']}): compile "
+                f"{compile_t:.1f}s, {arms[name]['step_time_s'] * 1e3:.2f} "
+                f"ms/step, {arms[name]['pts_per_sec']:,.0f} pts/s/chip "
+                f"(loss={float(loss):.6f})")
+        except Exception as e:
+            arms[name] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[minimax] {name} FAILED: {arms[name]['error']}")
+
+    mm, un = arms.get("minimax", {}), arms.get("unfused", {})
+    if "pts_per_sec" not in mm:
+        raise RuntimeError(f"minimax arm failed: {arms}")
+    speedup = (round(un["step_time_s"] / mm["step_time_s"], 3)
+               if "step_time_s" in un else None)
+    return {
+        "metric": ("AC-SA step time: fused-minimax vs unfused fused-XLA "
+                   f"(engine: {mm['engine']})"),
+        "value": round(mm["pts_per_sec"]),
+        "unit": "collocation-pts/sec/chip",
+        # the acceptance read: unfused step time / minimax step time
+        "vs_baseline": speedup,
+        "step_time_reduction": speedup,
+        "minimax": {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in mm.items()},
+        "unfused": {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in un.items()},
+        "loss_drift": (abs(mm["loss"] - un["loss"])
+                       if "loss" in mm and "loss" in un else None),
+    }
 
 
 # --------------------------------------------------------------------------- #
@@ -1347,6 +1458,8 @@ def worker_main(args):
                                    else vv) for kk, vv in v.items()}
                           for k, v in out.items()},
         }
+    elif args.minimax:
+        payload = bench_minimax(n_f, nx, nt, widths, n_steps)
     elif args.scale:
         # stream a payload line per completed point: if a later, larger
         # point hangs past the supervisor timeout, the salvage path in
@@ -1444,11 +1557,12 @@ def worker_main(args):
         payload = full_payload(res)
     else:
         hint_fused = engine_hint()
-        p_fused, p_dtype = precision_hint()
+        p_fused, p_dtype, p_mm = precision_hint()
         if p_dtype is not None:
             hint_fused = p_fused  # the bf16 config carries its own engine
         r = bench_jax_throughput(n_f, nx, nt, widths, n_steps,
-                                 fused=hint_fused, fused_dtype=p_dtype)
+                                 fused=hint_fused, fused_dtype=p_dtype,
+                                 minimax=p_mm)
         base = get_baseline(n_f, nx, widths, max(3, n_steps // 10))
         payload = {
             "metric": "AC SA-PINN training throughput (full minimax step)",
@@ -1879,7 +1993,11 @@ def main():
                          "residual engines on the SA train step")
     ap.add_argument("--precision", action="store_true",
                     help="compare f32-HIGHEST / f32-default / bf16 network "
-                         "configs")
+                         "configs (incl. the fused-minimax rows)")
+    ap.add_argument("--minimax", action="store_true",
+                    help="price the fused minimax step (residual + SA-λ "
+                         "loss + cotangents + λ-ascent as one fusion) "
+                         "against the unfused fused-XLA path")
     ap.add_argument("--scale", action="store_true",
                     help="single-chip throughput sweep over N_f up to 500k "
                          "(the reference's multi-GPU config)")
@@ -1895,8 +2013,8 @@ def main():
                          "first-query latency + N-tenant mixed u/residual "
                          "QPS through the fleet router")
     ap.add_argument("--mode", choices=["default", "full", "engines",
-                                       "precision", "scale", "remat",
-                                       "serving", "fleet"],
+                                       "precision", "minimax", "scale",
+                                       "remat", "serving", "fleet"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
     ap.add_argument("--slo", metavar="TARGET",
@@ -1949,16 +2067,18 @@ def main():
         worker_main(args)
         return
 
-    mode_flags = [f for f in ("--full", "--engines", "--precision", "--scale",
-                              "--remat", "--serving", "--fleet")
+    mode_flags = [f for f in ("--full", "--engines", "--precision",
+                              "--minimax", "--scale", "--remat",
+                              "--serving", "--fleet")
                   if getattr(args, f.lstrip("-"))]
 
     # Total wall budget.  The driver's no-flag invocation must finish well
     # inside its window (round 2 proved >~25 min gets killed, rc=124); the
     # explicit modes are watcher-driven with generous budgets of their own.
     default_budget = {"default": 1140, "engines": 2400, "precision": 2400,
-                      "scale": 7200, "remat": 2400, "serving": 1800,
-                      "fleet": 1800, "full": 86400}[mode_name(mode_flags)]
+                      "minimax": 1800, "scale": 7200, "remat": 2400,
+                      "serving": 1800, "fleet": 1800,
+                      "full": 86400}[mode_name(mode_flags)]
     budget = float(os.environ.get("BENCH_BUDGET", default_budget))
     t_start = time.time()
 

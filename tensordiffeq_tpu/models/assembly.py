@@ -64,6 +64,7 @@ def build_loss_fn(apply_fn: Callable,
                   data_X: Optional[jnp.ndarray] = None,
                   data_s: Optional[jnp.ndarray] = None,
                   residual_fn: Optional[Callable] = None,
+                  residual_loss_fn: Optional[Callable] = None,
                   causal_eps: Optional[float] = None,
                   causal_bins: int = 32,
                   time_index: Optional[int] = None,
@@ -83,6 +84,15 @@ def build_loss_fn(apply_fn: Callable,
       residual_fn: optional fused batched residual ``(params, X) -> preds``
         (one Taylor wavefront, :mod:`tensordiffeq_tpu.ops.fused`); the
         generic per-point engine is used when ``None``.
+      residual_loss_fn: optional fused *residual-loss* term
+        ``(params, lam_res, X) -> scalar`` replacing the whole
+        residual-evaluation + λ-weighting + reduction block with one fused
+        unit (the minimax engine,
+        :mod:`tensordiffeq_tpu.ops.pallas_minimax` — single-component
+        residuals, the λ semantics of this function reproduced inside).
+        Takes precedence over ``residual_fn`` for the residual term;
+        incompatible with ``causal_eps`` (cross-point bin weighting cannot
+        live inside the per-point fusion) — the solver gates on that.
       causal_eps / causal_bins / time_index / time_bounds: temporal
         causality weighting of the residual terms
         (:func:`~tensordiffeq_tpu.ops.losses.causal_residual_loss`) —
@@ -176,8 +186,16 @@ def build_loss_fn(apply_fn: Callable,
             components[f"BC_{i}"] = loss_bc
             loss_bcs = loss_bcs + loss_bc
 
-        f_preds = _as_tuple(_residual_eval(params, X_batch))
-        loss_res = 0.0
+        if residual_loss_fn is not None:
+            # the fused minimax unit: residual + λ weighting + reduction
+            # (and, under AD, every cotangent) in one fusion — single
+            # residual component by construction
+            loss_res = residual_loss_fn(params, lam_res, X_batch)
+            components["Residual_0"] = loss_res
+            f_preds = ()
+        else:
+            f_preds = _as_tuple(_residual_eval(params, X_batch))
+            loss_res = 0.0
         for j, f_pred in enumerate(f_preds):
             f_pred = f_pred.reshape(-1, 1)
             lam = lam_res[j] if j < len(lam_res) else None

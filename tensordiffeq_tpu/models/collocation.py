@@ -74,6 +74,7 @@ class CollocationSolverND:
                 network=None, lr: "float | Callable" = 0.005,
                 lr_weights: "float | Callable" = 0.005,
                 fused: Optional[bool] = None, fused_dtype=None,
+                minimax: Optional[bool] = None,
                 causal_eps=None, causal_bins: int = 32,
                 causal_delta: float = 0.99,
                 remat: bool = False, ntk_max_ratio: Optional[float] = 100.0,
@@ -120,10 +121,23 @@ class CollocationSolverND:
             explicit accuracy/throughput trade-off (measure it with
             ``bench.py --precision``); the numeric cross-check runs with a
             correspondingly widened tolerance band.  Requires a fused
-            engine (ignored with a warning for ``fused=False``).  Applies
-            to the Adam phase only: L-BFGS line searches break down on
-            bf16 gradient noise, so the Newton refinement phase always
-            runs a full-precision engine.
+            engine (ignored with a warning for ``fused=False``).  L-BFGS
+            refinement starts on the bf16 loss and automatically retreats
+            to a full-precision engine when its Wolfe line search
+            stagnates (the PERF.md-documented bf16 failure mode) — see
+            :func:`~tensordiffeq_tpu.training.lbfgs.fit_lbfgs`.
+          minimax: fused *minimax-step* engine selection
+            (:mod:`..ops.pallas_minimax`).  ``None`` (default)
+            auto-adopts, for the training loss, the fused unit that
+            computes residual + SA-λ-weighted loss + parameter cotangents
+            + the per-point λ-ascent direction in one fusion (the
+            VMEM-resident pallas kernel on real TPU, the fused-XLA jaxpr
+            elsewhere) whenever the residual qualifies (fused engine
+            active, single residual component, no ``causal_eps``, no
+            ``remat``) AND it passes the same numeric cross-check gate as
+            the fused residual; silently falls back otherwise.  ``False``
+            forces the unfused loss; ``True`` requires the minimax engine
+            and raises with the disqualifying reason.
           ntk_max_ratio: bound on the NTK weights' dynamic range
             (``Adaptive_type=3`` only): λ are clipped to ``ntk_max_ratio ×
             min(λ)``.  Default 100 — the raw paper formula was measured to
@@ -187,6 +201,7 @@ class CollocationSolverND:
         self.g = g
         self.dist = dist
         self.fused = fused
+        self.minimax = minimax
         # scalar -> single-stage ladder; sequence -> annealing schedule
         # (kept sorted ascending: the paper advances small -> large ε)
         if causal_eps is None:
@@ -350,8 +365,6 @@ class CollocationSolverND:
         the real collocation set; return the fastest engine's residual_fn
         (``None`` = generic).  Engine choice is config-dependent (network
         width, N_f, backend), so measuring beats guessing."""
-        import time as _time
-
         candidates = {"generic": None, "fused": self._fused_residual}
         if getattr(self, "_fuse_requests", None) is not None:
             # the VMEM-resident pallas table producer competes too, but only
@@ -401,26 +414,10 @@ class CollocationSolverND:
         timings = {}
         failures = {}
         for name, res_fn in candidates.items():
-            loss_fn = build_loss_fn(
-                self.apply_fn, self.domain.vars, self.n_out, self.f_model,
-                self.bcs, weight_outside_sum=self.weight_outside_sum,
-                g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=res_fn, remat=self.remat, **self._causal_kw)
-
-            def value_grad(params, X):
-                return jax.value_and_grad(
-                    lambda p: loss_fn(p, self.lambdas["BCs"],
-                                      self.lambdas["residual"], X)[0])(params)
-
-            step = jax.jit(value_grad)
             try:
-                out = step(self.params, self.X_f)  # compile + warm-up
-                jax.block_until_ready(out)
-                t0 = _time.perf_counter()
-                for _ in range(3):
-                    out = step(self.params, self.X_f)
-                jax.block_until_ready(out)
-                timings[name] = (_time.perf_counter() - t0) / 3
+                # the shared measurement protocol (also the basis of the
+                # minimax adoption race in _try_minimax)
+                timings[name] = self._time_loss_step(residual_fn=res_fn)
             except Exception as e:  # a candidate that cannot even compile
                 # (e.g. Mosaic lowering failure) is excluded, not fatal
                 failures[name] = e
@@ -443,20 +440,26 @@ class CollocationSolverND:
         residual engines and the CURRENT ``_causal_kw`` — called by
         ``compile`` and again by :meth:`_set_causal_eps` when the staged
         ε ladder advances (new jit keys; the persistent compile cache
-        makes repeats warm)."""
+        makes repeats warm).  An adopted minimax engine replaces the
+        residual term of the training loss (and, in its full-precision
+        flavor, of the refinement loss) with the single fused unit."""
+        mm = getattr(self, "_minimax_loss", None)
+        mm_refine = getattr(self, "_minimax_loss_refine", None)
         self.loss_fn = build_loss_fn(
             self.apply_fn, self.domain.vars, self.n_out, self.f_model,
             self.bcs, weight_outside_sum=self.weight_outside_sum, g=self.g,
             data_X=self.data_X, data_s=self.data_s,
-            residual_fn=self._fused_residual, remat=self.remat,
-            **self._causal_kw)
+            residual_fn=self._fused_residual, residual_loss_fn=mm,
+            remat=self.remat, **self._causal_kw)
         self.loss_fn_refine = self.loss_fn
-        if self._refine_residual is not self._fused_residual:
+        if (self._refine_residual is not self._fused_residual
+                or mm_refine is not mm):
             self.loss_fn_refine = build_loss_fn(
                 self.apply_fn, self.domain.vars, self.n_out, self.f_model,
                 self.bcs, weight_outside_sum=self.weight_outside_sum,
                 g=self.g, data_X=self.data_X, data_s=self.data_s,
-                residual_fn=self._refine_residual, remat=self.remat,
+                residual_fn=self._refine_residual,
+                residual_loss_fn=mm_refine, remat=self.remat,
                 **self._causal_kw)
 
     def _set_causal_eps(self, eps: float):
@@ -545,6 +548,175 @@ class CollocationSolverND:
             return False, e
         return crosscheck_grads(g_gen, g_fus, **grad_tols)
 
+    def _try_minimax(self):
+        """Build and cross-check the fused minimax loss engine
+        (:mod:`..ops.pallas_minimax`); adopt it for the training loss when
+        it qualifies and agrees with the generic loss numerically.  Records
+        the disqualifying reason in ``_minimax_fail_reason`` (surfaced by
+        ``minimax=True``)."""
+        from ..ops import pallas_minimax as pmm
+
+        try:
+            if self._causal_kw:
+                raise ValueError(
+                    "causal weighting bins residuals across points; the "
+                    "per-point minimax fusion cannot serve it")
+            if self.remat:
+                raise ValueError(
+                    "remat wraps the residual evaluation; the fused "
+                    "minimax loss already owns its memory layout")
+            reqs = self._fuse_requests
+            # raises for multi-component residuals (systems)
+            ncols = pmm.residual_columns(self.f_model, self.domain.vars,
+                                         self.n_out, reqs)
+            if ncols != 1:
+                raise ValueError(
+                    f"residual has {ncols} output columns; per-point λ "
+                    "weighting is defined for scalar residuals")
+            # pallas flavor only on real TPU hardware: interpret mode is a
+            # test vehicle, not a training engine (the XLA fallback is the
+            # CPU fast path — and what the interpret kernel is pinned
+            # against in tests/test_pallas.py)
+            use_pallas = pmm.available()
+            sq = pmm.build_minimax_sq_fn(
+                self.f_model, self.domain.vars, self.n_out, reqs,
+                self._fuse_shapes, precision=self.net.precision,
+                compute_dtype=self.fused_dtype, use_pallas=use_pallas,
+                # the flat (GEMM-friendly) wavefront layout would reshape
+                # across a GSPMD-sharded point axis under dist training
+                flat_matmul=not self.dist)
+            mm = pmm.make_minimax_residual_loss(
+                sq, weight_outside_sum=self.weight_outside_sum, g=self.g)
+            ok, why = self._crosscheck_minimax(mm)
+            if not ok:
+                raise ValueError(
+                    "minimax engine failed the numeric cross-check "
+                    "against the generic loss") from why
+            if self.fused == "autotune" and self.minimax is not True:
+                # autotune's contract is MEASURED engine choice: the
+                # minimax unit replaces the timed winner's residual term,
+                # so it must beat the unfused step it displaces, not just
+                # agree numerically (engine speed is config-dependent —
+                # the premise of autotune)
+                t_mm = self._time_loss_step(residual_loss_fn=mm)
+                t_un = self._time_loss_step(
+                    residual_fn=self._fused_residual)
+                if t_mm >= t_un:
+                    raise ValueError(
+                        f"autotune: minimax step measured slower than "
+                        f"the selected residual engine "
+                        f"({t_mm * 1e3:.2f}ms vs {t_un * 1e3:.2f}ms)")
+                log_event("autotune",
+                          f"minimax loss step: {t_mm * 1e3:.2f}ms vs "
+                          f"unfused {t_un * 1e3:.2f}ms — adopting",
+                          verbose=self.verbose,
+                          timings_ms={"minimax": t_mm * 1e3,
+                                      "unfused": t_un * 1e3})
+            self._minimax_loss = mm
+            self._minimax_kind = "pallas" if use_pallas else "xla"
+            self._minimax_loss_refine = mm
+            if self.fused_dtype is not None:
+                # full-precision flavor for L-BFGS retreat (same engine,
+                # full-precision matmuls)
+                sq32 = pmm.build_minimax_sq_fn(
+                    self.f_model, self.domain.vars, self.n_out, reqs,
+                    self._fuse_shapes, precision=self.net.precision,
+                    use_pallas=use_pallas, flat_matmul=not self.dist)
+                self._minimax_loss_refine = pmm.make_minimax_residual_loss(
+                    sq32, weight_outside_sum=self.weight_outside_sum,
+                    g=self.g)
+            log_event("fuse", "minimax engine adopted "
+                      f"({self._minimax_kind}: residual + SA-λ loss + "
+                      "cotangents + λ-ascent in one fusion)",
+                      verbose=self.verbose, engine=self._minimax_kind)
+        except Exception as e:
+            self._minimax_fail_reason = e
+            if self.minimax is True:
+                raise ValueError(
+                    "minimax=True but the fused minimax engine cannot be "
+                    "adopted") from e
+            log_event("fuse", f"minimax engine not adopted "
+                      f"({type(e).__name__}: {e}); keeping the unfused "
+                      "loss", verbose=self.verbose)
+
+    def _time_loss_step(self, residual_fn=None, residual_loss_fn=None,
+                        reps: int = 3):
+        """Seconds per jitted loss+grad step over the full training loss
+        with the given residual flavor — the same measurement
+        :meth:`_autotune_engine` takes per candidate (warm-up compile
+        excluded)."""
+        import time as _time
+
+        loss_fn = build_loss_fn(
+            self.apply_fn, self.domain.vars, self.n_out, self.f_model,
+            self.bcs, weight_outside_sum=self.weight_outside_sum,
+            g=self.g, data_X=self.data_X, data_s=self.data_s,
+            residual_fn=residual_fn, residual_loss_fn=residual_loss_fn,
+            remat=self.remat, **self._causal_kw)
+
+        def value_grad(params, X):
+            return jax.value_and_grad(
+                lambda p: loss_fn(p, self.lambdas["BCs"],
+                                  self.lambdas["residual"], X)[0])(params)
+
+        step = jax.jit(value_grad)
+        out = step(self.params, self.X_f)  # compile + warm-up
+        jax.block_until_ready(out)
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            out = step(self.params, self.X_f)
+        jax.block_until_ready(out)
+        return (_time.perf_counter() - t0) / reps
+
+    def _crosscheck_minimax(self, mm_loss, n_check: int = 32):
+        """Numerically compare the fused minimax loss term (value AND
+        gradients w.r.t. params and λ) against the generic engine's
+        residual term on a sample of the real collocation set — the same
+        gate :meth:`_crosscheck_fused` applies to residual values, now
+        applied to the fully-fused loss unit whose forward already carries
+        every cotangent.  Returns ``(ok, reason)``."""
+        from ..ops.fused import FusedMismatch, crosscheck_grads
+
+        n_s = min(n_check, int(self.X_f.shape[0]))
+        X_s = self.X_f[:n_s]
+        n_f = int(self.X_f.shape[0])
+        lam_res = [lam[:n_s] if (lam is not None
+                                 and getattr(lam, "ndim", 0) >= 1
+                                 and lam.shape[0] == n_f) else lam
+                   for lam in self.lambdas.get("residual", [])]
+        # residual-term-only losses (no BC dilution): assembly's own λ
+        # semantics on both sides, so the comparison can't drift from the
+        # training loss
+        gen = build_loss_fn(self.apply_fn, self.domain.vars, self.n_out,
+                            self.f_model, [],
+                            weight_outside_sum=self.weight_outside_sum,
+                            g=self.g)
+        mm = build_loss_fn(self.apply_fn, self.domain.vars, self.n_out,
+                           self.f_model, [],
+                           weight_outside_sum=self.weight_outside_sum,
+                           g=self.g, residual_loss_fn=mm_loss)
+
+        def val_grad(loss_fn):
+            def f(p, lr_):
+                return loss_fn(p, [], lr_, X_s)[0]
+            return jax.value_and_grad(f, argnums=(0, 1))(self.params,
+                                                         lam_res)
+
+        try:
+            v_m, g_m = val_grad(mm)
+        except Exception as e:  # e.g. a Mosaic/vjp lowering failure
+            return False, e
+        v_g, g_g = val_grad(gen)
+        rtol = 5e-3 if self.fused_dtype is None else 5e-2
+        err = abs(float(v_m) - float(v_g))
+        if not (err <= 1e-5 + rtol * abs(float(v_g))):  # NaN-safe form
+            return False, FusedMismatch(
+                f"minimax loss value {float(v_m):.6e} disagrees with the "
+                f"generic engine's {float(v_g):.6e}")
+        grad_tols = {} if self.fused_dtype is None \
+            else {"rtol": 1.5e-1, "atol": 1e-3}
+        return crosscheck_grads(g_g, g_m, **grad_tols)
+
     def _build(self):
         self._crosscheck_cache = {}  # generic reference, per (re)compile
         self._fused_residual = self._try_fuse() if self.fused is not False \
@@ -553,7 +725,7 @@ class CollocationSolverND:
             msg = ("fused=%r but the residual cannot be fused: it requires "
                    "the standard float32 tanh MLP and an f_model using "
                    "grad() combinators on untransformed coordinates with "
-                   "derivative orders <= 2 (or unmixed 3rd)" % (self.fused,))
+                   "derivative orders <= 3 (or unmixed 4th)" % (self.fused,))
             reason = getattr(self, "_fuse_fail_reason", None)
             if reason is not None:
                 raise ValueError(f"{msg}; analysis stopped on: "
@@ -605,6 +777,27 @@ class CollocationSolverND:
             self._refine_residual = _mfr(
                 self.f_model, self.domain.vars, self.n_out,
                 self._fuse_requests, precision=self.net.precision)
+
+        # fused minimax-step engine: residual + SA-λ loss + cotangents +
+        # λ-ascent direction as one fusion replacing the training loss's
+        # residual term (ops/pallas_minimax) — gated by the same numeric
+        # cross-check discipline as the fused residual above
+        self._minimax_loss = None
+        self._minimax_loss_refine = None
+        self._minimax_kind = None
+        self._minimax_fail_reason = None
+        if self.minimax is not False and self._fused_residual is not None \
+                and getattr(self, "_fuse_requests", None) is not None:
+            self._try_minimax()
+        elif self.minimax is True:
+            reason = getattr(self, "_fuse_fail_reason", None)
+            msg = ("minimax=True requires a fused residual engine "
+                   "(standard float32 tanh MLP + analyzable f_model)")
+            if reason is not None:
+                raise ValueError(f"{msg}; analysis stopped on: "
+                                 f"{type(reason).__name__}: {reason}") \
+                    from reason
+            raise ValueError(msg)
         self._assemble_losses()
 
         # jit-cached inference paths (params are traced args, so repeated
@@ -787,12 +980,26 @@ class CollocationSolverND:
                            else min(int(batch_sz), n_f_total))
             tele.cost_floor = analytic_step_floor(step_points,
                                                   self.layer_sizes)
+            mm_kind = getattr(self, "_minimax_kind", None)
+            if mm_kind is not None:
+                # the minimax kernel is a custom call XLA's cost model
+                # scores at zero FLOPs — substitute the channel-exact
+                # analytic count of the fused step when the floor guard
+                # trips, and disclose the basis (telemetry.costmodel)
+                from ..ops.pallas_minimax import n_channels
+                from ..telemetry.costmodel import analytic_minimax_flops
+                tele.cost_fallback = (
+                    analytic_minimax_flops(self.layer_sizes, step_points,
+                                           n_channels(self._fuse_requests)),
+                    "analytic-minimax")
             tele.on_fit_start(dict(
                 tf_iter=tf_iter, newton_iter=newton_iter, batch_sz=batch_sz,
                 N_f=int(self.X_f.shape[0]),
                 layer_sizes=list(self.layer_sizes),
                 Adaptive_type=self.Adaptive_type, dist=self.dist,
-                engine=("fused" if self._fused_residual is not None
+                engine=(f"fused-minimax-{mm_kind}" if mm_kind == "pallas"
+                        else "fused-minimax" if mm_kind is not None
+                        else "fused" if self._fused_residual is not None
                         else "generic"),
                 resample_every=resample_every,
                 causal_ladder=list(getattr(self, "causal_ladder", []) or []),
@@ -1116,13 +1323,24 @@ class CollocationSolverND:
                                      newton_prior + int(best[2]))),
                               phase="l-bfgs")
 
+            refine_loss, refine_fallback = self.loss_fn_refine, None
+            if self.fused_dtype is not None \
+                    and self.loss_fn is not self.loss_fn_refine:
+                # bf16 end-to-end: refinement starts on the bf16 fused
+                # loss (the same rate the Adam phase ran at) and retreats
+                # to the full-precision engine only when the Wolfe line
+                # search stagnates — the PERF.md-documented bf16 failure
+                # mode, now a fallback instead of a standing tax
+                refine_loss, refine_fallback = (self.loss_fn,
+                                                self.loss_fn_refine)
             params, best_params, best_loss, best_iter, lbfgs_losses = fit_lbfgs(
-                self.loss_fn_refine, self.params, self.lambdas, self.X_f,
+                refine_loss, self.params, self.lambdas, self.X_f,
                 maxiter=newton_iter, verbose=self.verbose,
                 eager=bool(newton_eager),
                 callback=(lb_callback if lb_every > 0 else None),
                 callback_every=lb_every, telemetry=tele,
-                iter0=newton_prior, preempt_flush=preempt_flush)
+                iter0=newton_prior, preempt_flush=preempt_flush,
+                loss_fn_fallback=refine_fallback)
             self.params = params
             self.losses.extend(lbfgs_losses)
             if tele is not None:

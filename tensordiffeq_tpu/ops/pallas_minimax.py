@@ -1,0 +1,316 @@
+"""One fused minimax step: collocation points → SA-λ-weighted residual loss
+→ parameter cotangents AND the per-point λ gradient-ascent direction, as a
+single fusion.
+
+The unfused training step evaluates the fused Taylor residual
+(:mod:`.fused`), materialises the ``[N, n_out]`` derivative tables, reduces
+them into the λ-weighted MSE, and lets reverse-mode AD transpose the whole
+chain.  Two measured costs ride along:
+
+* **HBM round-trips (TPU)** — each layer's channel-stacked activations
+  stream through HBM twice (forward store + backward re-read); PERF.md's
+  roofline puts the bf16+pallas step at ~16% MFU with HBM traffic as the
+  floor.
+* **a pathological transpose (CPU/XLA)** — the batched channel matmul
+  ``[C, N, w_in] @ W`` reverse-differentiates into a batched double
+  contraction that XLA's CPU backend lowers ~4× slower than the
+  mathematically identical flat GEMM (measured this round: 170 ms vs 81 ms
+  for the same wavefront gradient at N=8192, w=64).
+
+This module removes both by making the *loss term itself* the fused unit:
+``sq(layers, w, X) = Σ_p w_p · f_p(X)²`` is a ``jax.custom_vjp`` whose
+forward computes the value **and** every cotangent — weight/bias descent
+directions, the per-point ``∂/∂w`` that becomes the SA-λ ascent direction,
+and ``∂/∂X`` for gradient-based collocation adaptation — in one pass; the
+backward is three scalar multiplies.  Because the reduction happens inside
+the fusion, the engine owns its data layout: the wavefront runs
+``flat_matmul`` (the GEMM-friendly form) whenever the point axis is not
+GSPMD-sharded, and the pallas flavor keeps the entire wavefront + its VJP
+VMEM-resident per point-tile, so HBM traffic collapses to: points and λ in,
+scalar loss and parameter cotangents out.
+
+Every weighting mode of the SA family maps onto the per-point ``w`` channel
+(``w = λ²`` for type-1, ``w = g(λ)`` for the g-transform, scalar type-2 λ
+multiplies outside) with the λ chain rule composed by ordinary AD *outside*
+the fusion — elementwise on ``[N, 1]`` arrays, negligible traffic — so
+``ResilientFit``, telemetry, checkpointing, and the optimizer see an
+ordinary loss/grad function.
+
+The XLA fallback (``use_pallas=False``) runs the same math as one fused
+jaxpr and is the CPU tier-1 path; the pallas kernel is bit-compared against
+it in interpret mode (``tests/test_pallas.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .fused import SymbolicUFn, _TableEngine
+from .taylor import closure, taylor_derivatives
+
+try:  # pragma: no cover - import guard exercised only off-TPU
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _sorted_mis(requests: set) -> list:
+    return sorted(set(requests) | {()}, key=lambda t: (len(t), t))
+
+
+def available() -> bool:
+    """True when the TPU pallas backend can run (real TPU present)."""
+    return _HAS_PLTPU and jax.default_backend() == "tpu"
+
+
+def n_channels(requests: set) -> int:
+    """Channels the wavefront carries for a request set (primal included) —
+    the per-layer matmul multiplicity, which is also the analytic FLOP
+    multiplier the cost model quotes for the fused kernel
+    (:func:`~tensordiffeq_tpu.telemetry.costmodel.analytic_minimax_flops`)."""
+    firsts, seconds, thirds, fourths = closure(set(requests))
+    return 1 + len(firsts) + len(seconds) + len(thirds) + len(fourths)
+
+
+def residual_columns(f_model: Callable, varnames: Sequence[str], n_out: int,
+                     requests: set) -> int:
+    """Column count of the (single-component) residual the loss reduces
+    over — 1 for the scalar-output family the minimax fusion serves."""
+    ndim = len(varnames)
+    X = jnp.zeros((2, ndim), jnp.float32)
+
+    def run(X):
+        table = {mi: jnp.zeros((2, n_out), jnp.float32)
+                 for mi in _sorted_mis(requests)}
+        coords = tuple(X[:, i] for i in range(ndim))
+        u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
+        out = f_model(u, *coords)
+        if isinstance(out, tuple):
+            raise ValueError("minimax fusion serves single-component "
+                             "residuals only")
+        return jnp.reshape(out, (2, -1))
+
+    return int(jax.eval_shape(run, X).shape[1])
+
+
+def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
+                        n_out: int, requests: set,
+                        layer_shapes: Sequence[tuple],
+                        tile: int = 256, precision=None,
+                        interpret: bool = False, compute_dtype=None,
+                        use_pallas: bool = False,
+                        flat_matmul: bool = True) -> Callable:
+    """Build ``sq(layers, w, X) -> scalar = Σ_p w_p · f_p(X)²`` as the fused
+    minimax unit (see module docstring).
+
+    Args:
+      f_model: the user residual (single component; callers gate on
+        :func:`residual_columns`).
+      requests: canonical multi-indices the residual needs (primal implied).
+      layer_shapes: ``[(in, out), ...]`` static layer dims.
+      tile: points per grid step of the pallas kernel — the kernel holds
+        the tile's wavefront AND its VJP residuals in VMEM, so the budget
+        matches :mod:`.pallas_taylor`'s backward tile, not its forward one.
+      precision / compute_dtype: forwarded to
+        :func:`~.taylor.taylor_derivatives` (bf16 matmul operands with f32
+        accumulation under ``compute_dtype=jnp.bfloat16`` — the MXU's
+        native single-pass path, end-to-end through value AND cotangents).
+      use_pallas: VMEM-resident kernel (TPU, or ``interpret=True`` for CPU
+        equivalence tests) vs the fused-XLA jaxpr.
+      flat_matmul: run the wavefront in the GEMM-friendly flat layout
+        (``[C·N, w]``).  Must be ``False`` when the point axis is
+        GSPMD-sharded (``dist=True``) — the reshape would cross the shard.
+        The pallas path always runs flat inside the kernel (Mosaic cannot
+        lower the batched form's weight-cotangent transpose).
+
+    ``layers`` is the ``[(W, b), ...]`` list; ``w`` is the per-point weight
+    column ``[N, 1]`` (λ², g(λ), or ones — see
+    :func:`make_minimax_residual_loss`).  The returned callable is
+    ``custom_vjp``-wrapped: differentiating through it costs one fused
+    forward that already carries every cotangent.
+    """
+    mis = _sorted_mis(requests)
+    ndim = len(varnames)
+    n_layers = len(layer_shapes)
+    d_in = layer_shapes[0][0]
+
+    def tile_sq(layers, w, x, flat):
+        table = taylor_derivatives(list(layers), x, set(mis),
+                                   precision=precision, flat_matmul=flat,
+                                   compute_dtype=compute_dtype)
+        coords = tuple(x[:, i] for i in range(ndim))
+        u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
+        out = f_model(u, *coords)
+        f2 = jnp.square(jnp.reshape(out, (x.shape[0], -1)))
+        return jnp.sum(w * f2)
+
+    def unflatten(flat):
+        return [(flat[2 * i], flat[2 * i + 1]) for i in range(n_layers)]
+
+    if not use_pallas:
+        def fused_value(flat_layers, w, X):
+            return tile_sq(unflatten(flat_layers), w, X, flat_matmul)
+
+        def fused_value_and_grads(flat_layers, w, X):
+            val, vjp = jax.vjp(fused_value, flat_layers, w, X)
+            gl, gw, gx = vjp(jnp.ones((), val.dtype))
+            return val, (gl, gw, gx)
+    else:
+        def kernel(*refs):
+            x_ref, w_ref = refs[0], refs[1]
+            w_refs = refs[2:2 + 2 * n_layers]
+            s_ref = refs[2 + 2 * n_layers]
+            dwb_refs = refs[3 + 2 * n_layers:3 + 4 * n_layers]
+            dw_ref, dx_ref = refs[-2], refs[-1]
+            layers = tuple((w_refs[2 * i][...], w_refs[2 * i + 1][...])
+                           for i in range(n_layers))
+
+            def f(layers, wt, x):
+                return tile_sq(layers, wt, x, True)
+
+            val, vjp = jax.vjp(f, layers, w_ref[...], x_ref[...])
+            grads, gw, gx = vjp(jnp.ones((), val.dtype))
+            dw_ref[...] = gw
+            dx_ref[...] = gx
+
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _():
+                s_ref[...] = val.reshape(1, 1)
+
+            @pl.when(i != 0)
+            def _():
+                s_ref[...] += val.reshape(1, 1)
+
+            for li, (gW, gb) in enumerate(grads):
+                dW_ref, db_ref = dwb_refs[2 * li], dwb_refs[2 * li + 1]
+
+                @pl.when(i == 0)
+                def _(dW_ref=dW_ref, db_ref=db_ref, gW=gW, gb=gb):
+                    dW_ref[...] = gW
+                    db_ref[...] = gb
+
+                @pl.when(i != 0)
+                def _(dW_ref=dW_ref, db_ref=db_ref, gW=gW, gb=gb):
+                    dW_ref[...] += gW
+                    db_ref[...] += gb
+
+        def _whole(shape):  # weight-style block: resident across the grid
+            return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
+
+        def _tiled(ncols):  # point-axis block
+            return pl.BlockSpec((tile, ncols), lambda i: (i, 0))
+
+        w_specs, wb_shapes = [], []
+        for (fan_in, fan_out) in layer_shapes:
+            w_specs += [_whole((fan_in, fan_out)), _whole((1, fan_out))]
+            wb_shapes += [(fan_in, fan_out), (1, fan_out)]
+
+        def fused_value_and_grads(flat_layers, w, X):
+            N = X.shape[0]
+            n_tiles = -(-N // tile)
+            pad = n_tiles * tile - N
+            if pad:
+                # pad by REPLICATING a real collocation point, weighted 0:
+                # zero weight kills the value/dW contribution, and a valid
+                # point keeps the residual finite — an all-zero pad row
+                # would evaluate f_model AT the origin, where
+                # coordinate-singular PDEs (1/x, log x) produce a NaN that
+                # 0·NaN propagates into the whole in-kernel reduction
+                X = jnp.concatenate(
+                    [X, jnp.broadcast_to(X[:1], (pad, d_in))], 0)
+                w = jnp.concatenate([w, jnp.zeros((pad, 1), w.dtype)], 0)
+            outs = pl.pallas_call(
+                kernel,
+                grid=(n_tiles,),
+                in_specs=[_tiled(d_in), _tiled(1)] + w_specs,
+                out_specs=[_whole((1, 1))] + w_specs
+                + [_tiled(1), _tiled(d_in)],
+                out_shape=[jax.ShapeDtypeStruct((1, 1), X.dtype)]
+                + [jax.ShapeDtypeStruct(s, X.dtype) for s in wb_shapes]
+                + [jax.ShapeDtypeStruct((X.shape[0], 1), X.dtype),
+                   jax.ShapeDtypeStruct(X.shape, X.dtype)],
+                interpret=interpret,
+            )(X, w, *flat_layers)
+            val = outs[0].reshape(())
+            gl = tuple(outs[1:1 + 2 * n_layers])
+            gw, gx = outs[-2][:N], outs[-1][:N]
+            return val, (gl, gw, gx)
+
+        def fused_value(flat_layers, w, X):
+            return fused_value_and_grads(flat_layers, w, X)[0]
+
+    @jax.custom_vjp
+    def sq(flat_layers, w, X):
+        return fused_value(flat_layers, w, X)
+
+    def sq_fwd(flat_layers, w, X):
+        return fused_value_and_grads(flat_layers, w, X)
+
+    def sq_bwd(res, g):
+        gl, gw, gx = res
+        return (jax.tree_util.tree_map(lambda a: a * g, gl),
+                gw * g, gx * g)
+
+    sq.defvjp(sq_fwd, sq_bwd)
+
+    def sq_fn(layers, w, X):
+        # bias reshape to [1, fan_out] happens in traced code, so its
+        # transpose is handled by the outer AD, not the custom vjp
+        flat = tuple(arr if arr.ndim == 2 else arr.reshape(1, -1)
+                     for pair in layers for arr in pair)
+        return sq(flat, w, X)
+
+    return sq_fn
+
+
+def make_minimax_residual_loss(sq_fn: Callable,
+                               weight_outside_sum: bool = False,
+                               g=None) -> Callable:
+    """Wrap a :func:`build_minimax_sq_fn` unit as the solver's residual
+    loss term ``residual_loss(params, lam_res, X) -> scalar``, reproducing
+    :func:`~tensordiffeq_tpu.models.assembly.build_loss_fn`'s λ semantics:
+
+    * no λ            → ``mean(f²)``              (``w = 1``)
+    * per-point type-1 → ``mean((λ·f)²)``          (``w = λ²``)
+    * ``g`` transform  → ``mean(g(λ)·f²)``         (``w = g(λ)``)
+    * scalar type-2    → ``λ · mean(f²)``          (outer multiply)
+
+    The λ chain rule (``∂w/∂λ``) composes by ordinary AD outside the fused
+    unit — elementwise on ``[N, 1]`` — so the fused cotangent ``∂loss/∂w``
+    becomes the SA-λ gradient-ascent direction with no second traversal.
+    """
+    from .taylor import extract_mlp_layers
+
+    def residual_loss(params, lam_res, X):
+        layers = extract_mlp_layers(params)
+        if layers is None:
+            raise ValueError(
+                "minimax residual loss requires the standard MLP parameter "
+                "structure (Dense_0..Dense_k)")
+        N = X.shape[0]
+        lam = lam_res[0] if len(lam_res) > 0 else None
+        outer = None
+        if lam is None:
+            w = jnp.ones((N, 1), X.dtype)
+        elif g is not None:
+            w = jnp.broadcast_to(jnp.reshape(g(lam), (-1, 1)), (N, 1))
+        elif weight_outside_sum:
+            # scalar type-2 / NTK weight: scales the term's mean (per-point
+            # λ never reaches this branch — MSE(outside_sum) is scalar-only)
+            w = jnp.ones((N, 1), X.dtype)
+            outer = jnp.reshape(lam, ())
+        else:  # type-1: mean((λ·f)²), per-point or scalar λ
+            lam2 = jnp.broadcast_to(jnp.reshape(lam, (-1, 1)), (N, 1))
+            w = lam2 * lam2
+        loss = sq_fn(layers, w, X) / N
+        return loss if outer is None else outer * loss
+
+    return residual_loss

@@ -3,13 +3,20 @@
 The generic residual path evaluates the user's ``f_model`` with per-point
 ``jvp``/``grad`` chains: every requested derivative re-traverses the network.
 This module instead pushes ONE wavefront through the MLP that carries the
-primal together with every requested directional derivative (first, second —
-including mixed — and unmixed third order): per layer, all channels share a
-single batched matmul (``[C, N, w]``, channels on a fresh leading axis so
-the point axis keeps its dist-training sharding) and the tanh
-derivative chain ``d1 = 1-z², d2 = -2·z·d1, d3 = -2·d1·(1-3z²)`` is applied
-elementwise (VPU, fused by XLA).  Reverse-mode AD composes through it for the
-loss gradient, so no custom VJP is required for correctness.
+primal together with every requested directional derivative — first, second,
+arbitrary (mixed) third, and unmixed fourth order: per layer, all channels
+share a single batched matmul (``[C, N, w]``, channels on a fresh leading
+axis so the point axis keeps its dist-training sharding) and the tanh
+derivative chain ``d1 = 1-z², d2 = -2·z·d1, d3 = -2·d1·(1-3z²),
+d4 = -2·d2·(1-3z²) + 12·z·d1²`` is applied elementwise (VPU, fused by XLA).
+The higher orders use the collapsing recurrence of Collapsing Taylor Mode AD
+(arXiv:2505.13644): instead of re-traversing the network once per order
+(nested ``jacfwd`` towers), each layer advances every order of the wavefront
+interleaved — the order-k channel of the post-activation is a Faà di Bruno
+combination of the *already-propagated* lower-order channels of the same
+layer, so a fourth derivative costs one extra channel in the shared matmul,
+not a fourth traversal.  Reverse-mode AD composes through it for the loss
+gradient, so no custom VJP is required for correctness.
 
 This replaces, for the standard MLP family, the repeated network traversals
 of the combinator path (reference contract: batched ``tf.gradients`` over
@@ -18,7 +25,7 @@ higher-order requests fall back to the generic engine.
 
 Derivative requests are canonical multi-indices: sorted tuples of coordinate
 positions, e.g. ``()`` primal, ``(0,)`` = u_x, ``(0, 1)`` = u_xt,
-``(0, 0, 0)`` = u_xxx.
+``(0, 0, 1)`` = u_xxt, ``(0, 0, 0, 0)`` = u_xxxx.
 """
 
 from __future__ import annotations
@@ -39,31 +46,39 @@ def canonical(idx: Sequence[int]) -> MultiIndex:
 
 
 def supported(idx: Sequence[int]) -> bool:
-    """Orders handled by the propagation: everything to 2nd order, plus
-    unmixed 3rd order (covers e.g. KdV's u_xxx)."""
+    """Orders handled by the propagation: everything to 3rd order (mixed
+    included — KS/Burgers-type ``u_xxt``), plus unmixed 4th order (beam /
+    Kuramoto–Sivashinsky ``u_xxxx``)."""
     idx = canonical(idx)
-    if len(idx) <= 2:
+    if len(idx) <= 3:
         return True
-    return len(idx) == 3 and len(set(idx)) == 1
+    return len(idx) == 4 and len(set(idx)) == 1
 
 
-def closure(requests: set) -> tuple[list, list, list]:
+def closure(requests: set) -> tuple[list, list, list, list]:
     """Ingredient closure: propagate every channel a requested derivative
-    needs.  Returns (firsts, seconds, thirds) as sorted canonical lists."""
-    firsts, seconds, thirds = set(), set(), set()
+    needs (each order's Faà di Bruno recurrence consumes every lower-order
+    channel over the same index subsets).  Returns
+    ``(firsts, seconds, thirds, fourths)`` as sorted canonical lists."""
+    firsts, seconds, thirds, fourths = set(), set(), set(), set()
     for idx in requests:
         idx = canonical(idx)
         if len(idx) == 1:
             firsts.add(idx)
         elif len(idx) == 2:
             seconds.add(idx)
-            firsts.add((idx[0],))
-            firsts.add((idx[1],))
         elif len(idx) == 3:
             thirds.add(idx)
-            seconds.add((idx[0], idx[0]))
-            firsts.add((idx[0],))
-    return sorted(firsts), sorted(seconds), sorted(thirds)
+        elif len(idx) == 4:
+            fourths.add(idx)
+    for (k, _, _, _) in fourths:  # unmixed: one lower-order chain
+        thirds.add((k, k, k))
+    for (i, j, k) in thirds:  # all pairwise seconds feed the recurrence
+        seconds.update({canonical((i, j)), canonical((i, k)),
+                        canonical((j, k))})
+    for (i, j) in seconds:
+        firsts.update({(i,), (j,)})
+    return sorted(firsts), sorted(seconds), sorted(thirds), sorted(fourths)
 
 
 def extract_mlp_layers(params) -> Optional[list]:
@@ -111,9 +126,9 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
     """
     X = jnp.asarray(X)
     N, d = X.shape
-    firsts, seconds, thirds = closure(set(map(canonical, requests)))
+    firsts, seconds, thirds, fourths = closure(set(map(canonical, requests)))
 
-    # Channel wavefront. Z primal; T/S/U keyed by canonical multi-index.
+    # Channel wavefront. Z primal; T/S/U/F keyed by canonical multi-index.
     # Channels stack on a NEW leading axis: the point axis keeps its
     # position (and, under dist training, its sharding — stacking along the
     # sharded axis would make GSPMD gather the batch at every layer).
@@ -125,15 +140,18 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
          for idx in firsts}
     S = {idx: jnp.zeros_like(X) for idx in seconds}
     U = {idx: jnp.zeros_like(X) for idx in thirds}
+    F = {idx: jnp.zeros_like(X) for idx in fourths}
 
     order = [("z", ())] + [("t", i) for i in firsts] + \
-            [("s", i) for i in seconds] + [("u", i) for i in thirds]
+            [("s", i) for i in seconds] + [("u", i) for i in thirds] + \
+            [("f", i) for i in fourths]
 
     n_layers = len(layers)
     for li, (W, b) in enumerate(layers):
         stacked = jnp.stack(
             [Z] + [T[i] for i in firsts] + [S[i] for i in seconds]
-            + [U[i] for i in thirds], axis=0)  # [C, N, w_in]
+            + [U[i] for i in thirds] + [F[i] for i in fourths],
+            axis=0)  # [C, N, w_in]
         # one (batched) MXU matmul for every channel
         if compute_dtype is not None:
             lhs, rhs = stacked.astype(compute_dtype), W.astype(compute_dtype)
@@ -151,9 +169,10 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
         Q = {i: chunks[("t", i)] for i in firsts}
         R = {i: chunks[("s", i)] for i in seconds}
         V = {i: chunks[("u", i)] for i in thirds}
+        G = {i: chunks[("f", i)] for i in fourths}
 
         if li == n_layers - 1:  # linear head: channels pass through
-            Z, T, S, U = P, Q, R, V
+            Z, T, S, U, F = P, Q, R, V, G
             break
 
         Z = jnp.tanh(P)
@@ -163,15 +182,43 @@ def taylor_derivatives(layers: list, X: jnp.ndarray, requests: set,
         T = {i: d1 * Q[i] for i in firsts}
         S = {(i, j): d1 * R[(i, j)] + d2 * Q[(i,)] * Q[(j,)]
              for (i, j) in seconds}
-        # Faà di Bruno, third order along one direction k:
-        # (tanh∘g)''' = d3·g'³ + 3·d2·g'·g'' + d1·g'''
-        U = {(k, k, k): (d3 * Q[(k,)] ** 3
-                         + 3.0 * d2 * Q[(k,)] * R[(k, k)]
-                         + d1 * V[(k, k, k)])
-             for (k, _, _) in thirds}
+
+        def q(k):
+            return Q[(k,)]
+
+        def r(i, j):
+            return R[canonical((i, j))]
+
+        # Faà di Bruno, third order over directions (i, j, k) — repeated
+        # indices included (i=j=k collapses to the classic unmixed chain
+        # d3·g'³ + 3·d2·g'·g'' + d1·g'''):
+        # (tanh∘g)_ijk = d3·gᵢgⱼg_k + d2·(g_ij·g_k + g_ik·g_j + g_jk·g_i)
+        #               + d1·g_ijk
+        U = {(i, j, k): (d3 * q(i) * q(j) * q(k)
+                         + d2 * (r(i, j) * q(k) + r(i, k) * q(j)
+                                 + r(j, k) * q(i))
+                         + d1 * V[(i, j, k)])
+             for (i, j, k) in thirds}
+        if fourths:
+            # fourth derivative of tanh, continuing the d-chain
+            d4 = -2.0 * d2 * (1.0 - 3.0 * Z * Z) + 12.0 * Z * d1 * d1
+            # unmixed fourth order along k (Faà di Bruno over the
+            # partitions of a 4-set: {4}, {3,1}×4, {2,2}×3, {2,1,1}×6,
+            # {1,1,1,1}):
+            # (tanh∘g)_kkkk = d1·g_kkkk + 4·d2·g_kkk·g_k + 3·d2·g_kk²
+            #                + 6·d3·g_kk·g_k² + d4·g_k⁴
+            F = {(k, _k2, _k3, _k4): (d1 * G[(k, k, k, k)]
+                                      + 4.0 * d2 * V[(k, k, k)] * q(k)
+                                      + 3.0 * d2 * r(k, k) * r(k, k)
+                                      + 6.0 * d3 * r(k, k) * q(k) * q(k)
+                                      + d4 * q(k) ** 4)
+                 for (k, _k2, _k3, _k4) in fourths}
+        else:
+            F = {}
 
     table = {(): Z}
     table.update({i: T[i] for i in firsts})
     table.update({i: S[i] for i in seconds})
     table.update({i: U[i] for i in thirds})
+    table.update({i: F[i] for i in fourths})
     return table

@@ -84,11 +84,27 @@ class InferenceEngine:
         (``serving.engine.pad_waste``: padded-but-unused fraction of each
         bucket, the bucketing overhead an operator tunes ``min_bucket``
         against).  Defaults to the process-wide shared registry.
+      compute_dtype: mixed-precision query programs (e.g. ``"bfloat16"``):
+        every kind's matmuls run with operands cast to this dtype and
+        **float32 accumulation** — the MXU's native single-pass path —
+        behind the same pad-to-bucket ladder.  Served from the fused
+        Taylor propagation (:mod:`~tensordiffeq_tpu.ops.taylor`): ``u`` is
+        the primal channel, derivative kinds one wavefront each, and
+        ``residual`` the fused engine with ``compute_dtype`` — so the
+        serving path collapses its derivative towers exactly like
+        training.  Requires the standard float32 tanh MLP (raises at
+        construction otherwise) and, for residual queries, an analyzable
+        ``f_model``; derivative orders outside the propagation's reach
+        (:func:`~tensordiffeq_tpu.ops.taylor.supported`) fall back to the
+        full-precision per-point chain for that kind.  Results carry bf16
+        rounding (~3 significant digits) — an explicit opt-in trade; the
+        per-kind ``serving.engine.{flops,bytes}_per_point`` gauges price
+        the reduced-precision programs at first touch.
     """
 
     def __init__(self, surrogate: Surrogate, min_bucket: int = 256,
                  max_bucket: int = 1 << 20, shard: bool = False,
-                 donate: bool = True, registry=None):
+                 donate: bool = True, registry=None, compute_dtype=None):
         if _next_pow2(min_bucket) != min_bucket \
                 or _next_pow2(max_bucket) != max_bucket:
             raise ValueError("min_bucket and max_bucket must be powers of "
@@ -97,6 +113,17 @@ class InferenceEngine:
             raise ValueError(f"min_bucket {min_bucket} > max_bucket "
                              f"{max_bucket}")
         self.surrogate = surrogate
+        self._compute_dtype = None
+        if compute_dtype is not None:
+            import jax.numpy as jnp
+
+            from ..ops.fused import mlp_qualifies
+            self._compute_dtype = jnp.dtype(compute_dtype).type
+            if mlp_qualifies(surrogate.net, surrogate.params) is None:
+                raise ValueError(
+                    "compute_dtype requires the standard float32 tanh MLP "
+                    "(the reduced-precision programs run the fused Taylor "
+                    "propagation, which cannot differentiate this network)")
         self._buckets = tuple(min_bucket << i for i in range(
             (max_bucket // min_bucket).bit_length()))
         # the CPU backend can't reuse donated buffers and warns per compile
@@ -219,6 +246,8 @@ class InferenceEngine:
     def _make_fn(self, key):
         sur = self.surrogate
         if key == "u":
+            if self._compute_dtype is not None:
+                return self._make_fn_mixed(key)
             apply_fn = sur.apply_fn
             return lambda: apply_fn
         if key == "residual":
@@ -228,6 +257,10 @@ class InferenceEngine:
                     "this surrogate has no f_model attached; pass f_model= "
                     "to Surrogate.load (or export from a compiled solver) "
                     "to enable residual queries")
+            if self._compute_dtype is not None:
+                mixed = self._make_fn_mixed(key)
+                if mixed is not None:
+                    return mixed
 
             def make_res():
                 def batched(params, Xb):
@@ -243,6 +276,10 @@ class InferenceEngine:
             # consults UFn.__getitem__, which would otherwise catch this
             raise ValueError(f"component {component} out of range for an "
                              f"n_out={sur.n_out} surrogate")
+        if self._compute_dtype is not None:
+            mixed = self._make_fn_mixed(key)
+            if mixed is not None:
+                return mixed
 
         def make_d():
             def batched(params, Xb):
@@ -250,6 +287,54 @@ class InferenceEngine:
                 dfn = d(u if sur.n_out == 1 else u[component], idx, order)
                 return jax.vmap(
                     lambda pt: dfn(*(pt[i] for i in range(sur.ndim))))(Xb)
+            return batched
+
+        return make_d
+
+    def _make_fn_mixed(self, key):
+        """Reduced-precision program factory for one kind — the fused
+        Taylor propagation with ``compute_dtype`` matmul operands and f32
+        accumulation — or ``None`` when this kind cannot ride the
+        propagation (unsupported derivative order, unanalyzable f_model):
+        the caller then falls back to the full-precision per-point chain
+        for that kind only."""
+        sur = self.surrogate
+        cd = self._compute_dtype
+        precision = getattr(sur.net, "precision", None)
+        from ..ops.taylor import (extract_mlp_layers, supported,
+                                  taylor_derivatives)
+        if key == "u":
+            def make_u():
+                def batched(params, Xb):
+                    layers = extract_mlp_layers(params)
+                    return taylor_derivatives(layers, Xb, set(),
+                                              precision=precision,
+                                              compute_dtype=cd)[()]
+                return batched
+
+            return make_u
+        if key == "residual":
+            from ..ops.fused import analyze_f_model, make_fused_residual
+            reqs = analyze_f_model(sur.point_residual, sur.varnames,
+                                   sur.n_out)
+            if reqs is None:
+                return None
+            fused = make_fused_residual(
+                sur.point_residual, sur.varnames, sur.n_out, reqs,
+                precision=precision, compute_dtype=cd)
+            return lambda: fused
+        _, idx, order, component = key
+        mi = (idx,) * int(order)
+        if not supported(mi):
+            return None
+
+        def make_d():
+            def batched(params, Xb):
+                layers = extract_mlp_layers(params)
+                tab = taylor_derivatives(layers, Xb, {mi},
+                                         precision=precision,
+                                         compute_dtype=cd)
+                return tab[mi][:, component]
             return batched
 
         return make_d

@@ -134,6 +134,25 @@ def analytic_step_floor(n_points: int, dims: Sequence[int]) -> float:
     return analytic_mlp_flops(dims, n_points, passes=STEP_FORWARD_PASSES)
 
 
+def analytic_minimax_flops(dims: Sequence[int], n_points: int,
+                           n_channels: int,
+                           passes: float = STEP_FORWARD_PASSES) -> float:
+    """Channel-exact analytic model FLOPs for one fused minimax step
+    (:mod:`~tensordiffeq_tpu.ops.pallas_minimax`): the wavefront carries
+    ``n_channels`` derivative channels through every layer matmul
+    (``ops.pallas_minimax.n_channels`` counts them from the request
+    closure), and the fused forward-with-cotangents plus its scaling
+    backward still execute >= 3 forward-equivalent passes of MACs.  XLA
+    scores the pallas custom call at **zero** FLOPs, so this is the basis
+    substituted — and disclosed as ``"analytic-minimax"`` — when the floor
+    guard trips on a minimax-engine step; unlike the generic
+    :func:`analytic_step_floor` it prices the channels the kernel actually
+    moves, keeping ``cost.mfu`` honest instead of quoting a bound that is
+    ``n_channels``× too low."""
+    return float(n_channels) * analytic_mlp_flops(dims, n_points,
+                                                  passes=passes)
+
+
 def resolve_flop_basis(measured: Optional[float], floor: float,
                        fallback: Optional[Callable[[], Tuple[
                            Optional[float], Optional[str]]]] = None,
@@ -187,10 +206,16 @@ class StepCostModel:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  phase: str = "train", floor: Optional[float] = None,
-                 peak: Optional[float] = None, n_chips: int = 1):
+                 peak: Optional[float] = None, n_chips: int = 1,
+                 fallback: Optional[Tuple[float, str]] = None):
         self.registry = registry if registry is not None else default_registry()
         self.phase = str(phase)
         self.floor = floor
+        # (flops, basis_label) substituted when the floor guard trips —
+        # e.g. the channel-exact ("analytic-minimax") count for a
+        # pallas-minimax step; default: the floor itself, disclosed as a
+        # lower bound
+        self.fallback = fallback
         self.peak = peak if peak is not None else default_peak()
         self.n_chips = max(int(n_chips), 1)
         self.flops_per_step: Optional[float] = None
@@ -212,7 +237,9 @@ class StepCostModel:
         if self.floor is not None:
             resolved, basis = resolve_flop_basis(
                 flops, self.floor,
-                fallback=lambda: (self.floor, "analytic-floor"))
+                fallback=lambda: (self.fallback
+                                  if self.fallback is not None
+                                  else (self.floor, "analytic-floor")))
             self.flops_per_step, self.basis = resolved, basis
         else:
             self.flops_per_step = flops

@@ -130,6 +130,11 @@ class TrainingTelemetry:
         # the solver sets this before fit_adam runs so the floor guard
         # (costmodel.analytic_step_floor) rides into on_step_program
         self.cost_floor: Optional[float] = None
+        # optional (flops, basis_label) substituted when the floor guard
+        # trips — the solver sets the channel-exact "analytic-minimax"
+        # count here for minimax-engine steps (pallas custom calls score
+        # zero in XLA's cost model)
+        self.cost_fallback = None
         self._cost: Optional[StepCostModel] = None
         self._last_step_trace: Optional[str] = None
         # run-relative rebasing across causal-ε stages / resumed legs:
@@ -170,7 +175,8 @@ class TrainingTelemetry:
             return
         try:
             self._cost = StepCostModel(registry=self.registry, phase=phase,
-                                       floor=self.cost_floor)
+                                       floor=self.cost_floor,
+                                       fallback=self.cost_fallback)
             cost = self._cost.observe_program(lower_fn(), n_steps=n_steps)
         except Exception:
             self._cost = None

@@ -65,7 +65,8 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
                    callback: Optional[Callable] = None,
                    callback_every: int = 0, args: tuple = (),
                    telemetry=None, iter0: int = 0,
-                   preempt_flush: Optional[Callable] = None):
+                   preempt_flush: Optional[Callable] = None,
+                   fun_fallback: Optional[Callable] = None):
     """Minimise ``fun(pytree, *args) -> scalar`` with jitted L-BFGS.
 
     Returns ``(x_final, x_best, f_best, best_iter, history)`` where
@@ -93,62 +94,78 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     ``callback`` may have skipped this boundary), and
     :class:`~tensordiffeq_tpu.resilience.Preempted` is raised with the
     absolute iteration ``iter0 + done``.
+
+    ``fun_fallback``: the automatic precision retreat.  When set, ``fun``
+    is treated as a reduced-precision objective (the bf16 fused minimax
+    loss): a NaN stop or a ``tol_fun`` stagnation stop with budget
+    remaining — the two faces of a Wolfe line search drowning in bf16
+    gradient noise (PERF.md) — switches the remaining iterations to
+    ``fun_fallback`` (full precision), restarting the curvature memory
+    (bf16-era pairs mis-scale the f32 landscape) from the best finite
+    iterate so far.  The retreat happens at most once; genuine
+    convergence (gradient-norm stop) never triggers it.
     """
-    if eager:
-        opt = optax.lbfgs(learning_rate=learning_rate,
-                          memory_size=memory_size, linesearch=None)
-    else:
-        opt = optax.lbfgs(
-            memory_size=memory_size,
-            linesearch=optax.scale_by_zoom_linesearch(max_linesearch_steps=30))
-
-    @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(0, 1, 2))
-    def run_chunk(x, state, best, it0, fn_args, n_steps: int):
-        # bind the traced data refs: a closure over *tracers* is fine, it is
-        # the device-array closure that breaks multi-host
-        def fun_local(p):
-            return fun(p, *fn_args)
-
+    def make_runner(fn):
         if eager:
-            plain_vg = jax.value_and_grad(fun_local)
-
-            def value_and_grad(x, state):
-                return plain_vg(x)
+            opt = optax.lbfgs(learning_rate=learning_rate,
+                              memory_size=memory_size, linesearch=None)
         else:
-            value_and_grad = optax.value_and_grad_from_state(fun_local)
+            opt = optax.lbfgs(
+                memory_size=memory_size,
+                linesearch=optax.scale_by_zoom_linesearch(
+                    max_linesearch_steps=30))
 
-        def step(carry, i):
-            x, state, best = carry
-            value, grad = value_and_grad(x, state=state)
-            updates, state = opt.update(grad, state, x, value=value,
-                                        grad=grad, value_fn=fun_local)
-            x_new = optax.apply_updates(x, updates)
+        @partial(jax.jit, static_argnames=("n_steps",),
+                 donate_argnums=(0, 1, 2))
+        def run_chunk(x, state, best, it0, fn_args, n_steps: int):
+            # bind the traced data refs: a closure over *tracers* is fine,
+            # it is the device-array closure that breaks multi-host
+            def fun_local(p):
+                return fn(p, *fn_args)
+
             if eager:
-                # no line-search state to read the post-step value from;
-                # track best at the iterate we just evaluated
-                new_value, x_at = value, x
+                plain_vg = jax.value_and_grad(fun_local)
+
+                def value_and_grad(x, state):
+                    return plain_vg(x)
             else:
-                new_value = _tree_get(state, "value")
-                x_at = x_new
-            x = x_new
+                value_and_grad = optax.value_and_grad_from_state(fun_local)
 
-            x_best, f_best, i_best = best
-            # guard: never adopt a NaN/inf iterate as "best"
-            improved = jnp.isfinite(new_value) & (new_value < f_best)
-            best = (
-                jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(improved, new, old),
-                    x_at, x_best),
-                jnp.where(improved, new_value, f_best),
-                jnp.where(improved, it0 + i, i_best),
-            )
-            gnorm = _tree_norm(grad)
-            return (x, state, best), (new_value, gnorm)
+            def step(carry, i):
+                x, state, best = carry
+                value, grad = value_and_grad(x, state=state)
+                updates, state = opt.update(grad, state, x, value=value,
+                                            grad=grad, value_fn=fun_local)
+                x_new = optax.apply_updates(x, updates)
+                if eager:
+                    # no line-search state to read the post-step value
+                    # from; track best at the iterate we just evaluated
+                    new_value, x_at = value, x
+                else:
+                    new_value = _tree_get(state, "value")
+                    x_at = x_new
+                x = x_new
 
-        (x, state, best), (values, gnorms) = jax.lax.scan(
-            step, (x, state, best), jnp.arange(n_steps))
-        return x, state, best, values, gnorms
+                x_best, f_best, i_best = best
+                # guard: never adopt a NaN/inf iterate as "best"
+                improved = jnp.isfinite(new_value) & (new_value < f_best)
+                best = (
+                    jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(improved, new, old),
+                        x_at, x_best),
+                    jnp.where(improved, new_value, f_best),
+                    jnp.where(improved, it0 + i, i_best),
+                )
+                gnorm = _tree_norm(grad)
+                return (x, state, best), (new_value, gnorm)
 
+            (x, state, best), (values, gnorms) = jax.lax.scan(
+                step, (x, state, best), jnp.arange(n_steps))
+            return x, state, best, values, gnorms
+
+        return opt, run_chunk
+
+    opt, run_chunk = make_runner(fun)
     # copies: run_chunk donates its carried state, so the caller's x0 (the
     # solver's params) must stay valid — and opt.init's state aliases the
     # params buffers, which donation forbids (double-donate), so the state
@@ -159,6 +176,7 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
     history: list[float] = []
     f_prev = np.inf
     done = 0
+    retreated = fun_fallback is None
     pbar = progress_bar(maxiter, desc="L-BFGS") if verbose else None
     while done < maxiter:
         n = int(min(chunk, maxiter - done))
@@ -200,11 +218,41 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             pbar.update(n)
             pbar.set_postfix(loss=float(values[-1]))
         f_now = float(values[-1])
+        stop = None
         if not np.isfinite(f_now):  # NaN stop (reference optimizers.py:290-291)
+            stop = "non-finite"
+        elif abs(f_prev - f_now) < tol_fun:
+            stop = "stagnation"
+        if stop is not None and not retreated and done < maxiter:
+            # precision retreat: the reduced-precision objective stalled
+            # (or blew up) with budget left — finish on the full-precision
+            # one.  Curvature memory restarts: bf16-era pairs mis-scale
+            # the f32 landscape.  Resume from the best finite iterate.
+            retreated = True
+            _log_stop(f"{stop} on the reduced-precision loss at iter "
+                      f"{done}; retreating to the full-precision engine "
+                      f"for the remaining {maxiter - done} iters")
+            x_best, _, i_best = best
+            # x_best is ALWAYS the safe restart point: the best finite
+            # iterate, or the caller's initial params when nothing ever
+            # improved (a NaN first chunk) — never restart the f32 phase
+            # from a possibly-poisoned last iterate
+            x = tree_copy(x_best)
+            opt, run_chunk = make_runner(fun_fallback)
+            state = tree_copy(opt.init(x))
+            # re-measure the incumbent under the full-precision objective:
+            # a bf16-measured f_best can under-read by the engine's
+            # crosscheck band (~5e-2 rel) and veto genuinely better f32
+            # iterates in the improved-guard below
+            best = (x_best, jnp.asarray(fun_fallback(x_best, *args)),
+                    i_best)
+            f_prev = np.inf
+            continue
+        if stop == "non-finite":
             _log_stop(f"non-finite loss at iter {done} — "
                       "stopping, keeping best iterate")
             break
-        if abs(f_prev - f_now) < tol_fun:
+        if stop == "stagnation":
             _log_stop(f"tolerance stop at iter {done}: "
                       f"|f_prev-f_now|={abs(f_prev - f_now):.3e} < "
                       f"tol_fun={tol_fun:g} (f={f_now:.6e})")
@@ -226,9 +274,14 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
               verbose: bool = True, chunk: int = 100, eager: bool = False,
               callback: Optional[Callable] = None,
               callback_every: int = 0, telemetry=None, iter0: int = 0,
-              preempt_flush: Optional[Callable] = None):
+              preempt_flush: Optional[Callable] = None,
+              loss_fn_fallback: Optional[Callable] = None):
     """L-BFGS phase over network params with SA λ frozen
     (reference ``fit.py:60-89``).
+
+    ``loss_fn_fallback``: full-precision objective for the automatic
+    retreat when ``loss_fn`` is a reduced-precision (bf16) engine and its
+    line search stagnates — see :func:`lbfgs_minimize`.
 
     Returns ``(params_final, params_best, best_loss, best_iter, loss_dicts)``
     with ``loss_dicts`` shaped like the Adam history entries."""
@@ -241,13 +294,20 @@ def fit_lbfgs(loss_fn: Callable, params, lambdas, X_f,
     def fun(p, lam_bcs, lam_res, X_f, lam_data):
         return loss_fn(p, lam_bcs, lam_res, X_f, lam_data=lam_data)[0]
 
+    fun_fallback = None
+    if loss_fn_fallback is not None and loss_fn_fallback is not loss_fn:
+        def fun_fallback(p, lam_bcs, lam_res, X_f, lam_data):
+            return loss_fn_fallback(p, lam_bcs, lam_res, X_f,
+                                    lam_data=lam_data)[0]
+
     t0 = time.time()
     x, x_best, f_best, i_best, history = lbfgs_minimize(
         fun, params, maxiter=maxiter, memory_size=memory_size,
         chunk=chunk, verbose=verbose, eager=eager,
         callback=callback, callback_every=callback_every,
         args=(lam_bcs, lam_res, X_f, lam_data), telemetry=telemetry,
-        iter0=iter0, preempt_flush=preempt_flush)
+        iter0=iter0, preempt_flush=preempt_flush,
+        fun_fallback=fun_fallback)
     log_event("l-bfgs",
               f"{len(history)} iters in {time.time() - t0:.1f}s, "
               f"best loss {float(f_best):.3e} @ iter {int(i_best)}",

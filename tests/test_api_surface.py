@@ -41,6 +41,32 @@ ELASTIC_PARALLEL = ["initialize_multihost", "resolve_mesh", "make_mesh",
                     "shard_data_inputs", "data_sharding", "replicated"]
 
 
+# the fused-engine ops surface (docs/api.md Fused engines section, PR 9:
+# collapsed derivative towers + the fused minimax step)
+OPS_TAYLOR = ["canonical", "supported", "closure", "extract_mlp_layers",
+              "taylor_derivatives"]
+OPS_MINIMAX = ["available", "n_channels", "residual_columns",
+               "build_minimax_sq_fn", "make_minimax_residual_loss"]
+COSTMODEL = ["analytic_step_floor", "analytic_minimax_flops",
+             "resolve_flop_basis", "compiled_flops", "StepCostModel"]
+
+
+def test_ops_fused_engine_surface():
+    from tensordiffeq_tpu.ops import pallas_minimax, taylor
+    from tensordiffeq_tpu.telemetry import costmodel
+    missing = [f"ops.taylor.{n}" for n in OPS_TAYLOR
+               if not hasattr(taylor, n)]
+    missing += [f"ops.pallas_minimax.{n}" for n in OPS_MINIMAX
+                if not hasattr(pallas_minimax, n)]
+    missing += [f"telemetry.costmodel.{n}" for n in COSTMODEL
+                if not hasattr(costmodel, n)]
+    assert not missing, f"fused-engine ops surface missing: {missing}"
+    # the widened order set is itself API: callers gate on supported()
+    assert taylor.supported((0, 0, 1))        # mixed 3rd
+    assert taylor.supported((2, 2, 2, 2))     # unmixed 4th
+    assert not taylor.supported((0, 0, 1, 1))  # mixed 4th: generic engine
+
+
 def test_migration_same_path_symbols_resolve():
     missing = [f"tdq.{mod}.{name}"
                for mod, names in SAME_PATH.items()

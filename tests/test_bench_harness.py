@@ -142,11 +142,11 @@ def test_precision_hint_adopts_measured_best_bf16(tmp_path, monkeypatch):
     art_path = tmp_path / "BENCH_TPU_precision.json"
 
     # CPU backend (the test env): never hints
-    assert bench.precision_hint() == (None, None)
+    assert bench.precision_hint() == (None, None, None)
 
     import jax
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert bench.precision_hint() == (None, None)  # no artifact yet
+    assert bench.precision_hint() == (None, None, None)  # no artifact yet
 
     art = {"backend": "tpu", "precision": {
         "f32-highest": {"pts_per_sec": 100.0},
@@ -155,21 +155,31 @@ def test_precision_hint_adopts_measured_best_bf16(tmp_path, monkeypatch):
         "bf16-matmul": {"pts_per_sec": 50.0},
         "broken": {"error": "Mosaic"}}}
     art_path.write_text(json.dumps(art) + "\n")
-    assert bench.precision_hint() == ("pallas", "bfloat16")
+    assert bench.precision_hint() == ("pallas", "bfloat16", False)
 
     # the backend gate must hold even WITH a valid artifact present
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
-    assert bench.precision_hint() == (None, None)
+    assert bench.precision_hint() == (None, None, None)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
 
     # an explicit BENCH_ENGINE override wins outright: no dtype hint
     monkeypatch.setenv("BENCH_ENGINE", "generic")
-    assert bench.precision_hint() == (None, None)
+    assert bench.precision_hint() == (None, None, None)
     monkeypatch.delenv("BENCH_ENGINE")
 
     art["precision"]["bf16-pallas"]["pts_per_sec"] = 150.0
     art_path.write_text(json.dumps(art) + "\n")
-    assert bench.precision_hint() == (True, "bfloat16")
+    assert bench.precision_hint() == (True, "bfloat16", False)
+
+    # a winning bf16-minimax row replays the fused MINIMAX step — and the
+    # bf16-taylor/bf16-pallas rows replay minimax=False, the flavor they
+    # were measured with (the minimax element pins the loss engine)
+    art["precision"]["bf16-minimax"] = {"pts_per_sec": 400.0}
+    art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == (True, "bfloat16", True)
+    art["precision"]["bf16-minimax"]["pts_per_sec"] = 1.0
+    art_path.write_text(json.dumps(art) + "\n")
+    assert bench.precision_hint() == (True, "bfloat16", False)
 
     # the net-dtype config carries no end-to-end accuracy evidence: even
     # when fastest overall it is never ITSELF hinted — but it must not
@@ -178,18 +188,18 @@ def test_precision_hint_adopts_measured_best_bf16(tmp_path, monkeypatch):
     # headline at half the validated mixed-precision throughput)
     art["precision"]["bf16-matmul"]["pts_per_sec"] = 900.0
     art_path.write_text(json.dumps(art) + "\n")
-    assert bench.precision_hint() == (True, "bfloat16")
+    assert bench.precision_hint() == (True, "bfloat16", False)
 
     # ...and when no validated config beats the f32 rows, no hint at all
     art["precision"]["f32-highest"]["pts_per_sec"] = 5000.0
     art_path.write_text(json.dumps(art) + "\n")
-    assert bench.precision_hint() == (None, None)
+    assert bench.precision_hint() == (None, None, None)
     art["precision"]["f32-highest"]["pts_per_sec"] = 100.0
 
     art["precision"]["bf16-matmul"]["pts_per_sec"] = 1.0
     art_path.write_text(json.dumps(art) + "\n")
     monkeypatch.setenv("BENCH_DTYPE", "f32")
-    assert bench.precision_hint() == (None, None)
+    assert bench.precision_hint() == (None, None, None)
 
 
 def test_tpu_cache_rejects_non_hardware(tmp_path):
@@ -356,6 +366,49 @@ def test_serving_partial_carries_real_headline():
     assert p["value"] == 12345 and p["unit"] == "collocation-pts/sec/chip"
     assert "incomplete" in p["metric"] and "QPS" not in p["metric"]
     assert "note" in p
+
+
+def test_minimax_mode_registered():
+    """--minimax is a first-class mode: distinct cache artifact, a budget
+    entry, the --mode spelling maps onto it, and the engines artifact's
+    fused-minimax row resolves through the engine-hint map."""
+    bench = _load_bench()
+    assert bench.mode_name(["--minimax"]) == "minimax"
+    assert bench.tpu_cache_file(["--minimax"]).endswith(
+        "BENCH_TPU_minimax.json")
+    assert bench._ENGINE_MAP["fused-minimax"] is True
+
+
+def test_minimax_json_contract_on_cpu_fallback(tmp_path):
+    """`python bench.py --mode minimax` must emit ONE valid JSON line
+    pricing the fused minimax step against the unfused fused-XLA path —
+    and the contract IS the acceptance bar: on CPU the fused step shows a
+    measured step-time reduction (the fusion replaces the batched channel
+    matmul's pathological AD transpose; measured 2.36x at the BENCH_FAST
+    config on this host) at zero f32 loss drift."""
+    env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="420",
+               JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
+               BENCH_TPU_CACHE_DIR=str(tmp_path))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
+         "minimax"],
+        capture_output=True, text=True, timeout=500, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # supervisor: exactly one line
+    p = json.loads(lines[0])
+    assert p["unit"] == "collocation-pts/sec/chip"
+    assert isinstance(p["value"], (int, float)) and p["value"] > 0
+    assert p["minimax"]["engine"] == "fused-minimax-xla"  # CPU flavor
+    assert p["unfused"]["engine"] == "fused-xla"
+    assert p["step_time_reduction"] == p["vs_baseline"]
+    # the measured step-time reduction (>=1.1 leaves flake headroom under
+    # host throttle; the structural win is ~2x)
+    assert p["vs_baseline"] >= 1.1, p
+    assert p["loss_drift"] is not None
+    assert p["loss_drift"] <= 1e-4 * abs(p["minimax"]["loss"])
+    assert p["backend"] == "cpu"  # this env: the fallback really ran
 
 
 def test_fleet_mode_registered():

@@ -84,6 +84,54 @@ def test_fused_third_order_and_laplacian():
         rtol=5e-4, atol=5e-5)
 
 
+def test_taylor_fourth_and_mixed_third_match_autodiff():
+    """The widened order set of the collapsing recurrence
+    (arXiv:2505.13644): mixed 3rd and unmixed 4th channels cross-checked
+    against nested-autodiff oracles at micro widths."""
+    net, params, X = _setup(widths=(8, 8))
+    layers = extract_mlp_layers(params)
+    reqs = {(0, 0, 1), (0, 1, 1), (1, 1, 1),
+            (0, 0, 0, 0), (1, 1, 1, 1)}
+    table = taylor_derivatives(layers, X, reqs)
+
+    def u_scalar(x, t):
+        return net.apply(params, jnp.stack([x, t]))[0]
+
+    def nth(fn, axes):
+        for a in axes:
+            fn = jax.grad(fn, a)
+        return fn
+
+    for mi in sorted(reqs):
+        want = jax.vmap(nth(u_scalar, mi))(X[:, 0], X[:, 1])
+        got = table[mi][:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=2e-4,
+                                   err_msg=f"multi-index {mi}")
+
+
+def test_fused_ks_beam_residual_parity():
+    """KS/beam-type residual — u_xxxx plus a mixed u_xxt term — served by
+    the collapsed wavefront and cross-checked against the generic
+    per-point engine (the orders that used to force a generic fallback)."""
+    net, params, X = _setup(widths=(12, 12))
+
+    def f_model(u, x, t):
+        u_x = grad(u, "x")
+        return (grad(u, "t")(x, t) + u(x, t) * u_x(x, t)
+                + d(u, "x", 2)(x, t) + d(u, "x", 4)(x, t)
+                + 0.1 * grad(grad(u_x, "x"), "t")(x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    assert reqs is not None
+    assert (0, 0, 0, 0) in reqs and (0, 0, 1) in reqs
+    fused = make_fused_residual(f_model, ("x", "t"), 1, reqs)
+    np.testing.assert_allclose(
+        np.asarray(fused(params, X)),
+        np.asarray(_generic(f_model, net, params, 2)(X)),
+        rtol=2e-3, atol=2e-4)
+
+
 def test_fused_vector_system_parity():
     net, params, X = _setup(n_out=2)
 
@@ -139,28 +187,43 @@ def test_analysis_rejects_reordered_coordinates():
     assert analyze_f_model(f_model, ("x", "t"), 1) is None
 
 
-def test_analysis_rejects_fourth_order():
-    def f_model(u, x, t):
-        return d(u, "x", 4)(x, t)
+def test_analysis_rejects_fifth_and_mixed_fourth_order():
+    def f_model5(u, x, t):
+        return d(u, "x", 5)(x, t)
 
-    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+    def f_model_mixed4(u, x, t):
+        return grad(grad(grad(grad(u, "x"), "x"), "x"), "t")(x, t)
+
+    assert analyze_f_model(f_model5, ("x", "t"), 1) is None
+    assert analyze_f_model(f_model_mixed4, ("x", "t"), 1) is None
 
 
-def test_analysis_rejects_mixed_third_order():
-    def f_model(u, x, t):
+def test_analysis_accepts_mixed_third_and_unmixed_fourth_order():
+    """The collapsed wavefront (arXiv:2505.13644) serves mixed 3rd and
+    unmixed 4th orders — these must no longer fall back to the generic
+    engine."""
+    def f_model_xxt(u, x, t):
         return grad(grad(grad(u, "x"), "x"), "t")(x, t)
 
-    assert analyze_f_model(f_model, ("x", "t"), 1) is None
+    def f_model_xxxx(u, x, t):
+        return d(u, "x", 4)(x, t)
+
+    assert analyze_f_model(f_model_xxt, ("x", "t"), 1) == {(), (0, 0, 1)}
+    assert analyze_f_model(f_model_xxxx, ("x", "t"), 1) == {(), (0, 0, 0, 0)}
 
 
 def test_multi_index_helpers():
     assert canonical((1, 0)) == (0, 1)
     assert supported((0, 1)) and supported((2, 2, 2)) and supported(())
-    assert not supported((0, 0, 1)) and not supported((0, 0, 0, 0))
-    firsts, seconds, thirds = closure({(0, 0, 0), (0, 1)})
+    assert supported((0, 0, 1)) and supported((0, 0, 0, 0))
+    assert not supported((0, 0, 1, 1)) and not supported((0,) * 5)
+    firsts, seconds, thirds, fourths = closure({(0, 0, 0, 0), (0, 1, 1)})
     assert (0,) in firsts and (1,) in firsts
-    assert (0, 0) in seconds and (0, 1) in seconds
-    assert thirds == [(0, 0, 0)]
+    # the mixed third's recurrence consumes every pairwise second; the
+    # unmixed fourth chains down through (0,0,0) -> (0,0) -> (0,)
+    assert {(0, 0), (0, 1), (1, 1)} <= set(seconds)
+    assert {(0, 0, 0), (0, 1, 1)} <= set(thirds)
+    assert fourths == [(0, 0, 0, 0)]
 
 
 # --------------------------------------------------------------------- #

@@ -40,3 +40,51 @@ def test_early_stop_on_tolerance():
     _, _, f_best, _, hist = lbfgs_minimize(fun, x0, maxiter=1000, chunk=10)
     assert len(hist) < 1000  # converged and stopped early
     assert float(f_best) < 1e-10
+
+
+def test_precision_retreat_on_stagnation():
+    """``fun_fallback``: a reduced-precision objective whose line search
+    stagnates with budget left retreats (once) to the full-precision
+    objective and keeps minimizing — the bf16 L-BFGS failure mode
+    (PERF.md) handled as an automatic fallback instead of a standing tax.
+    The reduced objective here rounds the iterate through bf16, putting a
+    quantization floor under the loss exactly like bf16 gradient noise."""
+    # targets deliberately OFF the bf16 grid (small integers are exactly
+    # representable in bf16 and would let the reduced objective reach 0)
+    target = jnp.array([1.2345671, 2.3456782, 3.4567893, 4.5678914])
+
+    def fun_f32(x):
+        return jnp.sum((x - target) ** 2)
+
+    def fun_bf16(x):
+        xb = x.astype(jnp.bfloat16).astype(jnp.float32)
+        return jnp.sum((xb - target) ** 2)
+
+    x0 = jnp.zeros(4)
+    # reduced-precision alone: stalls on the quantization floor
+    _, _, f_bf, _, _ = lbfgs_minimize(fun_bf16, x0, maxiter=120, chunk=10,
+                                      verbose=False)
+    # with the retreat: finishes on the f32 objective, well below it
+    _, _, f_ret, _, _ = lbfgs_minimize(fun_bf16, x0, maxiter=120, chunk=10,
+                                       verbose=False, fun_fallback=fun_f32)
+    assert float(f_ret) < 1e-8, float(f_ret)
+    assert float(f_ret) < float(f_bf) * 1e-2
+    # non-finite from the very FIRST chunk (no improving iterate yet):
+    # the retreat must restart from the initial params (x_best is the
+    # caller's x0 copy), not the NaN-poisoned last iterate, re-measure
+    # the incumbent under the fallback, and still converge
+    def fun_nan(x):
+        return jnp.sum((x - target) ** 2) * jnp.float32("nan")
+
+    _, _, f_nan, _, _ = lbfgs_minimize(fun_nan, x0, maxiter=120, chunk=10,
+                                       verbose=False, fun_fallback=fun_f32)
+    assert float(f_nan) < 1e-8, float(f_nan)
+
+    # the retreat happens at most ONCE: an objective that is already
+    # converged when it "stagnates" restarts onto the fallback, re-
+    # stagnates immediately, and stops — bounded, still early, still
+    # converged (no retreat loop)
+    _, _, f_ok, _, hist = lbfgs_minimize(fun_f32, x0, maxiter=1000,
+                                         chunk=10, verbose=False,
+                                         fun_fallback=fun_f32)
+    assert float(f_ok) < 1e-10 and len(hist) < 1000

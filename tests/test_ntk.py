@@ -112,24 +112,41 @@ def test_ntk_weights_balance_traces():
 
 
 def test_ntk_max_ratio_bounds_dynamic_range():
-    """The default cap (measured necessity: uncapped weights starved the
-    Helmholtz residual 4500x and the network fit u=0) must bound
-    max(lam)/min(lam) while preserving the balancing direction."""
-    from tensordiffeq_tpu.ops.ntk import build_error_fns
+    """The cap (measured necessity: uncapped weights starved the Helmholtz
+    residual 4500x and the network fit u=0) must bound max(lam)/min(lam)
+    while preserving the balancing direction.
+
+    Re-derived 2026-08-03 (ROADMAP item 5's standing debt): the original
+    test asserted this micro config's uncapped range exceeds the DEFAULT
+    cap of 100 — an environment-sensitive precondition, not a property of
+    the clipping mechanism.  On the current toolchain the seed-0 range
+    measures ~81x (λ = [71.7, 83.4, 1.03]; the network init's trace
+    balance moved under jax/flax revisions), so the bound under test is
+    now derived from the measured uncapped range: a cap at half the range
+    is tripped by construction on every toolchain, and the mechanism's
+    contract — bounded range, uncapped terms bit-exact on the paper
+    formula, order preserved — is what's pinned.  CONVERGENCE.md
+    documents the evidence."""
     s_unb = make_ac(ntk_max_ratio=None)
-    s_cap = make_ac(ntk_max_ratio=100.0)
     lam_u = s_unb._ntk_fn(s_unb.params)
-    lam_c = s_cap._ntk_fn(s_cap.params)
     vals_u = [sc(v) for v in lam_u["BCs"] + lam_u["residual"]]
+    ratio_u = max(vals_u) / min(vals_u)
+    # the config must separate its terms at all for the cap to be
+    # exercisable (seed-0 measurement: ~81x; anything > 4 leaves room
+    # for a genuinely-tripped half-range cap)
+    assert ratio_u > 4
+    cap = ratio_u / 2
+    s_cap = make_ac(ntk_max_ratio=cap)
+    lam_c = s_cap._ntk_fn(s_cap.params)
     vals_c = [sc(v) for v in lam_c["BCs"] + lam_c["residual"]]
-    assert max(vals_u) / min(vals_u) > 100  # this config DOES trip the cap
-    assert max(vals_c) / min(vals_c) <= 100 * (1 + 1e-6)
+    assert max(vals_c) / min(vals_c) <= cap * (1 + 1e-6)
     # uncapped terms keep the exact paper weights AND their relative order
     # (capped terms are bit-identical ties, so ordering among them is
     # sort-implementation noise — exclude them from the order check)
     m = min(vals_c)
     unc = [(u, c) for u, c in zip(vals_u, vals_c)
-           if c < 100 * m * (1 - 1e-6)]
+           if c < cap * m * (1 - 1e-6)]
+    assert unc, "half-range cap left no term uncapped (minimum always is)"
     for u, c in unc:
         np.testing.assert_allclose(c, u, rtol=1e-5)
     unc_u = [u for u, _ in unc]
@@ -137,7 +154,7 @@ def test_ntk_max_ratio_bounds_dynamic_range():
     assert np.argsort(unc_u).tolist() == np.argsort(unc_c).tolist()
     # every capped term's uncapped weight exceeds every uncapped term's
     assert min(u for u, c in zip(vals_u, vals_c)
-               if c >= 100 * m * (1 - 1e-6)) >= max(unc_u)
+               if c >= cap * m * (1 - 1e-6)) >= max(unc_u)
 
 
 def test_ntk_weights_assimilation_data_term():

@@ -96,6 +96,113 @@ def test_pallas_fused_residual_end_to_end():
         rtol=1e-4, atol=1e-5)
 
 
+def test_pallas_minimax_matches_xla_fused():
+    """Interpret-mode pallas minimax kernel vs the fused-XLA fallback:
+    the loss value AND every cotangent the fused step emits — parameter
+    descent directions, the per-point ∂loss/∂w that becomes the SA-λ
+    ascent direction, and the point cotangent — must agree (the
+    equivalence pin the CPU tier-1 carries for the TPU kernel)."""
+    from tensordiffeq_tpu.ops.derivatives import grad
+    from tensordiffeq_tpu.ops.fused import analyze_f_model
+    from tensordiffeq_tpu.ops.pallas_minimax import build_minimax_sq_fn
+
+    layers, shapes, X = _setup(n=70)  # 70 = 2*32 + 6: pad path included
+
+    def f_model(u, x, t):  # AC-type: primal + u_t + u_xx
+        return (grad(u, "t")(x, t) - 0.05 * grad(grad(u, "x"), "x")(x, t)
+                + u(x, t) ** 3 - u(x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    assert reqs is not None
+    w = jnp.asarray(np.random.RandomState(2).rand(70, 1), jnp.float32)
+
+    sq_xla = build_minimax_sq_fn(f_model, ("x", "t"), 1, reqs, shapes)
+    sq_pl = build_minimax_sq_fn(f_model, ("x", "t"), 1, reqs, shapes,
+                                tile=32, interpret=True, use_pallas=True)
+
+    def val_and_cotangents(sq):
+        val, vjp = jax.vjp(sq, layers, w, X)
+        gl, gw, gx = vjp(jnp.ones((), val.dtype))
+        return val, gl, gw, gx
+
+    v_x, gl_x, gw_x, gx_x = val_and_cotangents(sq_xla)
+    v_p, gl_p, gw_p, gx_p = val_and_cotangents(sq_pl)
+    np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_x),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gl_p),
+                    jax.tree_util.tree_leaves(gl_x)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_x),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_x),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_minimax_pad_rows_stay_finite_for_singular_f_model():
+    """Padded rows replicate a REAL collocation point (at weight 0), so a
+    residual that is singular at the origin (1/x terms — cylindrical/
+    spherical operators) stays finite through the in-kernel reduction.
+    Regression: an all-zero pad row evaluated f_model at the origin and
+    0·NaN poisoned the whole loss whenever N was not a tile multiple."""
+    from tensordiffeq_tpu.ops.derivatives import grad
+    from tensordiffeq_tpu.ops.fused import analyze_f_model
+    from tensordiffeq_tpu.ops.pallas_minimax import build_minimax_sq_fn
+
+    layers, shapes, _ = _setup()
+    rng = np.random.RandomState(5)
+    # points bounded away from x=0 (the PDE's own domain would be too)
+    X = jnp.asarray(np.stack([rng.uniform(0.5, 1.5, 40),
+                              rng.uniform(-1, 1, 40)], -1), jnp.float32)
+
+    def f_model(u, x, t):  # cylindrical-Laplacian-style 1/x term
+        return grad(u, "t")(x, t) + grad(u, "x")(x, t) / x
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    w = jnp.asarray(rng.rand(40, 1), jnp.float32)
+    sq_xla = build_minimax_sq_fn(f_model, ("x", "t"), 1, reqs, shapes)
+    sq_pl = build_minimax_sq_fn(f_model, ("x", "t"), 1, reqs, shapes,
+                                tile=32, interpret=True, use_pallas=True)
+    v_x = sq_xla(layers, w, X)
+    v_p = sq_pl(layers, w, X)
+    assert np.isfinite(float(v_p)), "pad rows poisoned the reduction"
+    np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_every_pallas_kernel_has_interpret_mode_test():
+    """CI guard: every ``ops/`` module that launches a pallas kernel
+    (``pallas_call``) must be exercised by an interpret-mode CPU test in
+    THIS file.  Interpret mode is the only pre-hardware signal tier-1 has
+    — it already missed three Mosaic-only failures once (PERF.md); zero
+    coverage would miss everything."""
+    import os
+    import re
+
+    import tensordiffeq_tpu.ops as ops_pkg
+    ops_dir = os.path.dirname(ops_pkg.__file__)
+    with open(__file__) as fh:
+        this_src = fh.read()
+    missing = []
+    for fn in sorted(os.listdir(ops_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(ops_dir, fn)) as fh:
+            src = fh.read()
+        if not re.search(r"\bpallas_call\s*\(", src):
+            continue
+        mod = fn[:-3]
+        # registered = this file imports the module AND drives something
+        # from it under interpret=True (the import is the anchor; every
+        # kernel builder here takes interpret=)
+        if f"ops.{mod} import" not in this_src:
+            missing.append(mod)
+    assert "interpret=True" in this_src
+    assert not missing, (
+        f"ops modules with a pallas_call but no interpret-mode test "
+        f"registered in tests/test_pallas.py: {missing}")
+
+
 def test_pallas_point_cotangent_matches_xla():
     """d(loss)/dX through the pallas table must match the XLA propagation
     (gradient-based collocation adaptation differentiates through X)."""

@@ -348,3 +348,63 @@ def test_engine_rejects_wrong_coordinate_width():
         eng.u(np.zeros(4, np.float32))
     # the single-point [ndim] convenience still works
     assert eng.u(np.zeros(2, np.float32)).shape == (1, 1)
+
+
+# --------------------------------------------------------------------------- #
+# bf16 query buckets (compute_dtype): the serving face of the bf16 path
+# --------------------------------------------------------------------------- #
+def test_engine_bf16_buckets_track_f32_within_rounding():
+    """``compute_dtype="bfloat16"``: every kind is served from the fused
+    Taylor propagation with bf16 matmul operands and f32 accumulation,
+    behind the same pad-to-bucket ladder — results track the f32 engine
+    within bf16 rounding, and derivative orders outside the propagation's
+    reach fall back to the full-precision per-point chain for that kind
+    only (bit-equal to the f32 engine there)."""
+    s, _ = make_solver(fused=True)
+    s.fit(tf_iter=5, newton_iter=0, chunk=5)
+    sur = s.export_surrogate()
+    e32 = sur.engine(min_bucket=64, max_bucket=256)
+    e16 = sur.engine(min_bucket=64, max_bucket=256,
+                     compute_dtype="bfloat16")
+    X = query_points(100, seed=7)
+
+    # primal / first / second derivative / residual: the bf16 wavefront
+    for name, q32, q16 in [
+            ("u", e32.u(X), e16.u(X)),
+            ("u_x", e32.derivative(X, "x"), e16.derivative(X, "x")),
+            ("u_xx", e32.derivative(X, "x", order=2),
+             e16.derivative(X, "x", order=2)),
+            ("residual", e32.residual(X), e16.residual(X))]:
+        scale = float(np.max(np.abs(np.asarray(q32)))) + 1e-6
+        err = float(np.max(np.abs(np.asarray(q16) - np.asarray(q32))))
+        assert err <= 5e-2 * scale, (name, err, scale)
+        assert err > 0.0 or name == "u_xx", name  # really the bf16 program
+
+    # out-of-reach order (5th, unmixed): per-kind fallback to the f32
+    # per-point chain — bit-equal to the full-precision engine
+    d32 = e32.derivative(X, "x", order=5)
+    d16 = e16.derivative(X, "x", order=5)
+    assert np.array_equal(np.asarray(d32), np.asarray(d16))
+
+
+def test_engine_compute_dtype_requires_standard_mlp():
+    """A network the fused propagation cannot differentiate is rejected at
+    engine construction, not at first query."""
+    import jax.numpy as jnp
+    from tensordiffeq_tpu.networks import neural_net
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 16)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(64, seed=0)
+    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]])]
+
+    def f_model(u, x, t):
+        return grad(u, "t")(x, t)
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 8, 8, 1], f_model, domain, bcs, fused=False,
+              network=neural_net([2, 8, 8, 1], dtype=jnp.bfloat16))
+    sur = s.export_surrogate()
+    with pytest.raises(ValueError, match="compute_dtype"):
+        sur.engine(min_bucket=64, compute_dtype="bfloat16")

@@ -512,3 +512,133 @@ def test_remat_identical_loss_and_grads():
     s = build(True, None)
     s.fit(tf_iter=60, newton_iter=0)
     assert s.losses[-1]["Total Loss"] < s.losses[0]["Total Loss"]
+
+
+def test_minimax_engine_adopts_and_matches_unfused_fit():
+    """The fused minimax loss engine (residual + SA-λ loss + cotangents +
+    λ-ascent in one fusion, ops/pallas_minimax) auto-adopts behind the
+    compile-time numeric cross-check gate — and the SA training
+    trajectory matches the unfused loss within the documented 1e-4
+    relative drift (PR 9 acceptance bar)."""
+    def build(minimax):
+        domain, bcs, f_model = make_burgers(n_f=256)
+        init_weights = {"residual": [np.random.RandomState(0).rand(256, 1)],
+                        "BCs": [100 * np.random.RandomState(1).rand(32, 1),
+                                None, None]}
+        dict_adaptive = {"residual": [True], "BCs": [True, False, False]}
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 10, 10, 1], f_model, domain, bcs, Adaptive_type=1,
+                  dict_adaptive=dict_adaptive, init_weights=init_weights,
+                  minimax=minimax)
+        return s
+
+    s_mm = build(None)  # default: auto-adopt
+    assert s_mm._minimax_kind == "xla"  # CPU: the fused-XLA flavor
+    s_un = build(False)
+    assert s_un._minimax_kind is None
+
+    # per-evaluation agreement at the 1e-4 bar (value + identical λ
+    # semantics), then a short SA fit trajectory inside the same band
+    t_mm, _ = s_mm.update_loss()
+    t_un, _ = s_un.update_loss()
+    assert abs(float(t_mm) - float(t_un)) <= 1e-4 * abs(float(t_un))
+    s_mm.fit(tf_iter=20, newton_iter=0, chunk=10)
+    s_un.fit(tf_iter=20, newton_iter=0, chunk=10)
+    mm = [float(d["Total Loss"]) for d in s_mm.losses]
+    un = [float(d["Total Loss"]) for d in s_un.losses]
+    np.testing.assert_allclose(mm, un, rtol=5e-4)
+    # λ ascent ran through the fused cotangent path too
+    assert not np.allclose(np.asarray(s_mm.lambdas["residual"][0]),
+                           np.random.RandomState(0).rand(256, 1))
+
+
+def test_minimax_true_raises_with_reason_when_disqualified():
+    """minimax=True surfaces the disqualifying reason instead of a silent
+    fallback (causal weighting cannot live inside the per-point fusion)."""
+    domain, bcs, f_model = make_burgers(n_f=128)
+    s = CollocationSolverND(verbose=False)
+    with pytest.raises(ValueError, match="minimax"):
+        s.compile([2, 8, 8, 1], f_model, domain, bcs, minimax=True,
+                  causal_eps=0.1)
+
+
+def test_fourth_order_residual_fuses_and_adopts_minimax():
+    """Beam/KS-type u_xxxx residuals no longer fall back to the generic
+    engine for standard tanh MLPs (fused=True would raise on fallback),
+    and the minimax loss engine adopts on top of the widened order set."""
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], 32)
+    domain.add("t", [0.0, 1.0], 8)
+    domain.generate_collocation_points(256, seed=0)
+    bcs = [IC(domain, [lambda x: np.sin(np.pi * x)], var=[["x"]])]
+
+    def f_model(u, x, t):  # beam-type: u_t + u_xxxx, plus a mixed u_xxt
+        u_xx = grad(grad(u, "x"), "x")
+        return (grad(u, "t")(x, t) + 0.1 * grad(grad(u_xx, "x"), "x")(x, t)
+                + 0.01 * grad(u_xx, "t")(x, t))
+
+    s = CollocationSolverND(verbose=False)
+    s.compile([2, 10, 10, 1], f_model, domain, bcs, fused=True)
+    assert s._fused_residual is not None
+    assert s._minimax_kind == "xla"
+    s.fit(tf_iter=10, newton_iter=0, chunk=5)
+    assert np.isfinite(float(s.losses[-1]["Total Loss"]))
+
+
+def test_bf16_lbfgs_refinement_converges_to_f32_gate():
+    """bf16 end-to-end (PR 9 acceptance): under fused_dtype the L-BFGS
+    phase STARTS on the bf16 fused loss and retreats to the f32 engine
+    only when the line search stagnates — end accuracy must land at the
+    f32 run's gate, not at the bf16 noise floor the old always-f32 rule
+    was protecting against."""
+    def run(fd):
+        domain, bcs, f_model = make_burgers(n_f=256)
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 10, 10, 1], f_model, domain, bcs, fused=True,
+                  fused_dtype=fd)
+        s.fit(tf_iter=40, newton_iter=60, chunk=20)
+        return float(s.min_loss["overall"])
+
+    f32 = run(None)
+    bf16 = run("bfloat16")
+    # the f32 gate: same order of magnitude as the full-precision run
+    # (identical seed/draw/budget; the retreat is what closes the gap)
+    assert np.isfinite(bf16)
+    assert bf16 <= 2.0 * f32 + 1e-3, (bf16, f32)
+
+
+def test_minimax_autotune_adoption_is_measured(monkeypatch):
+    """Under fused="autotune" the minimax unit must BEAT the measured
+    residual-engine winner's step to be adopted (autotune's contract is
+    measured choice, not numeric agreement alone); an explicit
+    minimax=True skips the race."""
+    from tensordiffeq_tpu.models.collocation import CollocationSolverND as C
+
+    def build(times, minimax=None):
+        domain, bcs, f_model = make_burgers(n_f=128)
+        s = CollocationSolverND(verbose=False)
+        if times is not None:
+            # _time_loss_step is the SHARED measurement: _autotune_engine
+            # consumes one value per candidate (generic, fused on CPU),
+            # then the minimax race consumes (minimax, unfused)
+            it = iter(times)
+            monkeypatch.setattr(
+                C, "_time_loss_step",
+                lambda self, **kw: next(it), raising=True)
+        s.compile([2, 8, 8, 1], f_model, domain, bcs, fused="autotune",
+                  minimax=minimax)
+        return s
+
+    # autotune picks fused (1.0 < 2.0); minimax times slower than the
+    # unfused step (3.0 vs 1.5) -> NOT adopted, reason recorded
+    s = build(times=[2.0, 1.0, 3.0, 1.5])
+    assert s._minimax_kind is None
+    assert "slower" in str(s._minimax_fail_reason)
+    # minimax times faster (1.0 vs 2.0) -> adopted
+    s = build(times=[2.0, 1.0, 1.0, 2.0])
+    assert s._minimax_kind == "xla"
+    # explicit minimax=True: adoption forced with NO race — exactly two
+    # timings (the candidate pick) are consumed; a race would exhaust
+    # the iterator and fail the build
+    s = build(times=[2.0, 1.0], minimax=True)
+    assert s._minimax_kind == "xla"

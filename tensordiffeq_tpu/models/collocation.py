@@ -40,6 +40,58 @@ from ..utils import initialize_lambdas, tree_copy
 from .assembly import build_loss_fn
 
 
+class _DeviceResampleHook:
+    """``fit_adam``-facing adapter around
+    :class:`~tensordiffeq_tpu.ops.resampling.DeviceResampler`: owns epoch
+    re-basing (restored history + causal-stage offsets), keeps the
+    solver's ``X_f`` in sync at swap time (the host mirror goes stale
+    rather than paying a device→host pull per redraw), and prices the
+    score pass once for the live cost model."""
+
+    pipelined = True
+
+    def __init__(self, solver, sampler, epoch_offset: int):
+        self.solver = solver
+        self.sampler = sampler
+        self.epoch_offset = int(epoch_offset)
+        self.stage_offset = 0
+        self._flops = None
+
+    def dispatch(self, params, X_cur, epoch: int):
+        return self.sampler.redraw(
+            params, X_cur, epoch + self.epoch_offset + self.stage_offset)
+
+    def on_swap(self, X_new):
+        s = self.solver
+        s.X_f = X_new
+        # stale marker: host-side consumers (NTK subsample, restore
+        # templates) re-sync lazily via _sync_X_f_host()
+        s._X_f_host = None
+
+    def flops_info(self):
+        """``(flops, basis)`` of one redraw's score+select program —
+        credited to the overlapped chunk so ``cost.mfu`` stays honest.
+        The analytic single-forward-pass floor substitutes when XLA's
+        cost model is blinded (a pallas residual engine scores zero)."""
+        if self._flops is None:
+            from ..telemetry.costmodel import (analytic_mlp_flops,
+                                               program_cost,
+                                               resolve_flop_basis)
+            s = self.solver
+            n_pool = self.sampler.n_f + self.sampler.n_fresh
+            floor = analytic_mlp_flops(s.layer_sizes, n_pool)
+            measured = None
+            try:
+                measured = program_cost(
+                    self.sampler.lower_redraw(s.params, s.X_f))["flops"]
+            except Exception:
+                pass
+            self._flops = resolve_flop_basis(
+                measured, floor,
+                fallback=lambda: (floor, "analytic-resample"))
+        return self._flops
+
+
 class CollocationSolverND:
     """N-dimensional collocation PINN solver (forward problems).
 
@@ -860,6 +912,27 @@ class CollocationSolverND:
             self._build()
 
     # ------------------------------------------------------------------ #
+    def _sync_X_f_host(self) -> np.ndarray:
+        """Host copy of the LIVE collocation set.  Device-resident
+        resampling leaves the mirror stale (``None``) instead of paying a
+        device→host pull per redraw; host-side consumers (NTK residual
+        subsample, restore templates) re-sync lazily here.  On a
+        multi-process mesh the global array is assembled from each
+        process's addressable shards (``np.asarray`` on a cross-host
+        array is illegal)."""
+        host = getattr(self, "_X_f_host", None)
+        if host is not None:
+            return host
+        X = self.X_f
+        if getattr(X, "is_fully_addressable", True):
+            host = np.asarray(X, np.float32)
+        else:
+            from ..ops.resampling import gather_rows_multihost
+            host = np.asarray(gather_rows_multihost(X), np.float32)
+        self._X_f_host = host
+        return host
+
+    # ------------------------------------------------------------------ #
     def update_loss(self):
         """Current composite loss and components on the full collocation set
         (debug/inspection parity with reference ``models.py:116-218``)."""
@@ -876,7 +949,7 @@ class CollocationSolverND:
             eval_fn: Optional[Callable] = None, eval_every: int = 0,
             resample_every: int = 0, resample_pool: int = 4,
             resample_temp: float = 1.0, resample_uniform: float = 0.1,
-            resample_seed: int = 0,
+            resample_seed: int = 0, resample_device: Optional[bool] = None,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0,
             telemetry=None, grad_clip: Optional[float] = None):
@@ -915,12 +988,32 @@ class CollocationSolverND:
 
         ``resample_every`` (beyond-reference; :mod:`..ops.resampling`):
         every that many Adam epochs, redraw the N_f collocation points by
-        residual-importance sampling from a fresh ``resample_pool``×N_f LHS
-        pool (``p ∝ |f|^resample_temp`` with a ``resample_uniform`` floor).
-        Shapes and sharding are preserved, so the compiled step and Adam
-        moments carry on; the L-BFGS phase refines on the final redraw.
-        Incompatible with per-point residual λ (Adaptive_type=1), whose rows
-        are trained state aligned to their points — the solver raises.
+        residual-importance sampling from a ``resample_pool``×N_f
+        candidate pool (``p ∝ |f|^resample_temp`` with a
+        ``resample_uniform`` floor).  Shapes and sharding are preserved,
+        so the compiled step and Adam moments carry on; the L-BFGS phase
+        refines on the final redraw.
+
+        ``resample_device`` selects the implementation.  Default
+        (``None``/``True``): the **device-resident** redraw — pool
+        generation (stratified ``jax.random``), residual scoring, and
+        Gumbel top-k selection run as ONE jitted program under the
+        training sharding, double-buffered behind the training chunks
+        (dispatched at the due boundary, swapped in at the next — the
+        pool/score/select wall time hides behind compute; the selection
+        is one chunk stale, the PACMANN-style pipelining trade).  Its
+        pool is ``[current points ; fresh candidates]``, so selected
+        current rows KEEP their per-point residual λ (gathered on-device
+        alongside their points, λ-ascent Adam moments included) and fresh
+        rows initialize from the adaptive SA-λ schedule (the carried
+        distribution's current mean, arXiv:2207.04084) — per-point λ
+        (Adaptive_type=1) therefore composes with resampling.
+        ``False``: the original host path (numpy LHS pool, host Gumbel
+        top-k, synchronous) — kept as the cross-implementation reference;
+        it still raises under Adaptive_type=1 (its pool is entirely
+        fresh).  Each redraw lands in telemetry (``resample.*`` gauges:
+        kept fraction, score gain, λ drift, host-visible stall) and as a
+        ``train.resample`` span.
 
         ``telemetry`` (beyond-reference;
         :mod:`tensordiffeq_tpu.telemetry`): a
@@ -963,6 +1056,7 @@ class CollocationSolverND:
                                 resample_temp=resample_temp,
                                 resample_uniform=resample_uniform,
                                 resample_seed=resample_seed,
+                                resample_device=resample_device,
                                 telemetry=telemetry, grad_clip=grad_clip)
         tele = as_training_telemetry(telemetry)
         epochs_at_entry = len(self.losses)
@@ -1026,32 +1120,61 @@ class CollocationSolverND:
         resample_fn = None
         if resample_every > 0:
             n_f = int(X_f.shape[0])
-            for lam in lambdas.get("residual", []):
-                if (lam is not None and getattr(lam, "ndim", 0) >= 1
-                        and lam.shape[0] == n_f):
-                    raise ValueError(
-                        "resample_every is incompatible with per-point "
-                        "residual λ (Adaptive_type=1): those weights are "
-                        "trained state row-aligned to their points. Use "
-                        "Adaptive_type 0/2/3, or disable resampling.")
-            from ..ops.resampling import make_residual_resampler
-            base_resampler = make_residual_resampler(
-                self._residual_jit, self.domain.xlimits, n_f,
-                pool_factor=resample_pool, temp=resample_temp,
-                uniform_frac=resample_uniform, seed=resample_seed, like=X_f)
+            per_point = any(
+                lam is not None and getattr(lam, "ndim", 0) >= 1
+                and lam.shape[0] == n_f
+                for lam in lambdas.get("residual", []))
+            # remedy-ladder floor (resilience.ResilientFit's
+            # resample_uniform rung): a drift-induced divergence bumps
+            # this so post-rollback redraws explore more uniformly
+            # instead of re-concentrating onto the same hot set
+            uniform_frac = max(
+                float(resample_uniform),
+                float(getattr(self, "_resample_uniform_floor", 0.0) or 0.0))
             # fit_adam restarts epoch numbering at 0 each call; offset by the
             # epochs already trained so a warm-restarted fit() explores new
             # pools instead of replaying the previous run's draws
             epoch_offset = len(self.losses)
+            if resample_device is not False:
+                # device-resident (default): pool→score→select in one
+                # jitted program, double-buffered behind the training
+                # chunks by fit_adam; kept rows carry per-point λ, so
+                # Adaptive_type=1 composes
+                from ..ops.resampling import DeviceResampler
+                sampler = DeviceResampler(
+                    self._residual_jit, self.domain.xlimits, n_f,
+                    pool_factor=resample_pool, temp=resample_temp,
+                    uniform_frac=uniform_frac, seed=resample_seed,
+                    like=X_f)
+                resample_fn = _DeviceResampleHook(self, sampler,
+                                                  epoch_offset)
+            else:
+                if per_point:
+                    raise ValueError(
+                        "resample_device=False (the host-path redraw) is "
+                        "incompatible with per-point residual λ "
+                        "(Adaptive_type=1): the host pool is entirely "
+                        "fresh, so trained λ rows have no points to ride. "
+                        "Use the device-resident path (resample_device="
+                        "None/True, the default), which keeps the current "
+                        "points in the pool and carries kept rows' λ "
+                        "through the redraw.")
+                from ..ops.resampling import make_residual_resampler
+                base_resampler = make_residual_resampler(
+                    self._residual_jit, self.domain.xlimits, n_f,
+                    pool_factor=resample_pool, temp=resample_temp,
+                    uniform_frac=uniform_frac, seed=resample_seed,
+                    like=X_f)
 
-            def resample_fn(params, epoch):
-                X_new = base_resampler(params, epoch + epoch_offset)
-                # later phases (L-BFGS) and fit() calls use the final redraw
-                self.X_f = X_new
-                host = getattr(base_resampler, "last_host", None)
-                if host is not None:
-                    self._X_f_host = host
-                return X_new
+                def resample_fn(params, epoch):
+                    X_new = base_resampler(params, epoch + epoch_offset)
+                    # later phases (L-BFGS) and fit() calls use the final
+                    # redraw
+                    self.X_f = X_new
+                    host = getattr(base_resampler, "last_host", None)
+                    if host is not None:
+                        self._X_f_host = host
+                    return X_new
 
         # L-BFGS iterations completed BEFORE this fit call (nonzero only
         # after a checkpoint restore) — checkpoint metadata records
@@ -1125,7 +1248,12 @@ class CollocationSolverND:
                         "n_f": int(np.shape(self.X_f)[0]),
                         # restores rebuild the opt_state template with the
                         # same clipping config, or the pytrees won't match
-                        "grad_clip": grad_clip}
+                        "grad_clip": grad_clip,
+                        # sampler state beyond X_f: the remedy-ladder
+                        # uniform floor, so a relaunched run keeps the
+                        # calmer redraw distribution the supervisor chose
+                        "resample_uniform_floor": float(getattr(
+                            self, "_resample_uniform_floor", 0.0) or 0.0)}
                 if cand:
                     bl, bi, ph, bp = min(cand, key=lambda c: c[0])
                     state["best_params"] = bp
@@ -1168,12 +1296,10 @@ class CollocationSolverND:
                 from ..ops.ntk import residual_subsample
 
                 def ntk_update(p):
-                    src = getattr(self, "_X_f_host", None)
-                    if src is None:  # pre-refactor pickles: device array
-                        src = self.X_f
                     return self._ntk_fn(
                         p, residual_subsample(
-                            src, getattr(self, "ntk_max_points", 256)))
+                            self._sync_X_f_host(),
+                            getattr(self, "ntk_max_points", 256)))
             # staged causal-ε ladder (Wang et al. 2203.07404 Alg. 1): run
             # Adam at each ε in ascending order, advancing the moment the
             # causal gate opens (min Causal_w_last > causal_delta at a
@@ -1218,8 +1344,13 @@ class CollocationSolverND:
                         lambda e, p: fn(e + _o, p))
                 res_fn = resample_fn
                 if resample_fn is not None and off:
-                    def res_fn(p, e, _o=off):  # (params, epoch) order
-                        return resample_fn(p, e + _o)
+                    if getattr(resample_fn, "pipelined", False):
+                        # hook object: re-base via its stage offset (the
+                        # dispatch/swap protocol has no wrappable call)
+                        resample_fn.stage_offset = off
+                    else:
+                        def res_fn(p, e, _o=off):  # (params, epoch) order
+                            return resample_fn(p, e + _o)
                 hook = ckpt_hook
                 if hook is not None and off:
                     def hook(tr, st, e, best=None, _o=off, **kw):
@@ -1452,7 +1583,9 @@ class CollocationSolverND:
                 "has_opt_state": self.opt_state is not None,
                 "has_X_f": True,
                 "n_f": int(np.shape(self.X_f)[0]),
-                "grad_clip": getattr(self, "_opt_grad_clip", None)}
+                "grad_clip": getattr(self, "_opt_grad_clip", None),
+                "resample_uniform_floor": float(getattr(
+                    self, "_resample_uniform_floor", 0.0) or 0.0)}
         # carry the best iterate too, so predict(best_model=True) survives
         # a save/restore cycle (phase buckets tie-break before "overall",
         # which always mirrors one of them — restores re-bucket by phase)
@@ -1560,6 +1693,11 @@ class CollocationSolverND:
         # the restored moments carry this clipping config; a fit() with a
         # different grad_clip restarts them (see the stale-state check)
         self._opt_grad_clip = _meta_peek.get("grad_clip")
+        # sampler state: a supervisor-bumped redraw uniform floor survives
+        # the relaunch (prevention, not rollback — resilience.recovery)
+        floor = float(_meta_peek.get("resample_uniform_floor", 0.0) or 0.0)
+        if floor > 0.0:
+            self._resample_uniform_floor = floor
         if mesh is not None:
             # restored λ come back host-resident; re-apply the data-parallel
             # placement so per-point λ resume sharded alongside their points

@@ -21,15 +21,31 @@ residual is large.  This module adds that as a *redraw*, not a point-mover:
 TPU-shaped by construction: ``N_f`` is constant, so the training step's
 compiled program, optimizer state, and (under ``dist``) the ``"data"``
 sharding layout are all reused — the host only swaps the buffer contents
-between device chunks.  Incompatible with *per-point* residual λ
-(Adaptive_type=1): those weights are row-aligned with their points and have
-trained ascent state; the solver raises rather than silently re-seeding
-them (scalar/outside-sum and NTK weighting compose fine).
+between device chunks.
+
+Two implementations share the selection semantics:
+
+* the original **host path** (:func:`make_residual_resampler`): numpy LHS
+  pool, scores pulled to the host, numpy Gumbel top-k, ``device_put``
+  back.  Kept as the ``resample_device=False`` fallback and the
+  cross-implementation reference.  Incompatible with *per-point* residual
+  λ (Adaptive_type=1) — its pool is entirely fresh, so there are no rows
+  to carry trained λ for;
+* the **device path** (:class:`DeviceResampler`): pool generation
+  (``jax.random``, stratified per dimension so LHS-like coverage
+  survives), residual scoring under the existing ``"data"`` sharding, and
+  Gumbel top-k via ``jax.lax.top_k`` in ONE jitted program — no host copy
+  of pool or scores, and on multi-host meshes the selection consumes the
+  globally-sharded scores directly (no ``process_allgather``).  Its pool
+  is ``[current X_f ; fresh candidates]`` (PACMANN-style), so selected
+  rows with index < N_f are *kept* points whose per-point λ (and λ-ascent
+  moments) ride through the redraw — lifting the Adaptive_type=1
+  restriction.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
@@ -65,7 +81,13 @@ def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
     else:
         p = (1.0 - uniform_frac) * p / tot + uniform_frac / s.size
     gumbel = rng.gumbel(size=s.size)
-    keys = np.log(p) + gumbel
+    # clamp the floor before the log: with uniform_frac=0 a zero-residual
+    # row has p=0 and log(0) = -inf poisons its key — the row becomes
+    # permanently unselectable (plus a numpy RuntimeWarning) even when
+    # n_keep exceeds the nonzero count.  The tiny floor keeps every row
+    # reachable through its Gumbel noise while leaving nonzero
+    # probabilities untouched at float64 scale.
+    keys = np.log(np.maximum(p, np.finfo(np.float64).tiny)) + gumbel
     return np.argpartition(-keys, n_keep)[:n_keep]
 
 
@@ -89,17 +111,36 @@ def residual_scores(residual_fn: Callable, params, X) -> np.ndarray:
     return s
 
 
-def _scores_multihost(residual_fn: Callable, params, X_global,
-                      n_pool: int) -> np.ndarray:
-    """``[n_pool]`` global scores when the pool spans multiple processes.
+def _allgather_by_row(local: dict, n: int) -> np.ndarray:
+    """Assemble the full ``[n, w]`` float64 array every process agrees on
+    from per-process row slices (``local``: global start row → this
+    process's values for that slice, ``[k]`` or ``[k, w]``).
 
-    ``np.asarray`` on a cross-host array is illegal, so each process reads
-    only its addressable shards (row slices of the global pool), and the
-    (row, score) pairs ride ONE ``process_allgather`` — every process then
-    holds the full score vector and the subsequent seeded selection is
-    bit-identical everywhere."""
+    ``np.asarray`` on a cross-host array is illegal, so the (row, values)
+    pairs ride ONE ``process_allgather`` — row indices travel as a
+    float64 lane (exact up to 2^53) and each block scatters back into
+    place, so the result is bit-identical everywhere.  The one packing
+    scheme both the score path and the X_f-gather path use."""
     from jax.experimental import multihost_utils
 
+    rows = np.concatenate([np.arange(s, s + v.shape[0])
+                           for s, v in sorted(local.items())])
+    vals = np.concatenate([np.asarray(v, np.float64).reshape(v.shape[0], -1)
+                           for _, v in sorted(local.items())])
+    packed = np.concatenate([rows[:, None].astype(np.float64), vals], axis=1)
+    packed_all = np.asarray(multihost_utils.process_allgather(packed))
+    packed_all = packed_all.reshape(-1, packed.shape[1])
+    out = np.zeros((n, vals.shape[1]), np.float64)
+    out[packed_all[:, 0].astype(np.int64)] = packed_all[:, 1:]
+    return out
+
+
+def _scores_multihost(residual_fn: Callable, params, X_global,
+                      n_pool: int) -> np.ndarray:
+    """``[n_pool]`` global scores when the pool spans multiple processes:
+    each process scores only its addressable shards (row slices of the
+    global pool) and :func:`_allgather_by_row` assembles the full vector,
+    so the subsequent seeded selection is bit-identical everywhere."""
     f = residual_fn(params, X_global)
     parts = f if isinstance(f, tuple) else (f,)
     local: dict[int, np.ndarray] = {}
@@ -108,17 +149,207 @@ def _scores_multihost(residual_fn: Callable, params, X_global,
             a = _row_scores(shard.data)
             start = shard.index[0].start or 0
             local[start] = local.get(start, 0.0) + a
-    rows = np.concatenate([np.arange(s, s + v.size)
-                           for s, v in sorted(local.items())])
-    vals = np.concatenate([v for _, v in sorted(local.items())])
-    # one collective: rows ride along as a float64 lane (exact up to 2^53)
-    packed = np.stack([rows.astype(np.float64), vals])
-    packed_all = np.asarray(multihost_utils.process_allgather(packed))
-    packed_all = packed_all.reshape(-1, 2, packed.shape[1])
-    scores = np.zeros(n_pool, np.float64)
-    for block in packed_all:
-        scores[block[0].astype(np.int64)] = block[1]
-    return scores
+    return _allgather_by_row(local, n_pool)[:, 0]
+
+
+class ResampleSwap(NamedTuple):
+    """One device redraw's results, still device-resident.
+
+    ``X_new``: the selected ``[n_f, d]`` collocation set (training
+    placement applied).  ``idx``: each new row's pool index, sorted
+    ascending; a value ``< n_f`` means the row is a *kept* current point
+    (``idx`` then IS its old row index — the λ-carry gather map).
+    ``kept``: boolean mask ``idx < n_f``.  ``stats``: scalar diagnostics
+    (``kept_fraction``, ``score_gain`` = mean selected |f| over mean pool
+    |f|) — read them on the host only at swap time, so the dispatch stays
+    asynchronous."""
+
+    X_new: jnp.ndarray
+    idx: jnp.ndarray
+    kept: jnp.ndarray
+    stats: dict
+
+
+def _stratified_pool(key, n: int, xlimits) -> jnp.ndarray:
+    """``[n, d]`` LHS-like stratified draw with ``jax.random``: each
+    dimension splits its range into ``n`` equal strata, places one sample
+    per stratum, and shuffles strata independently per dimension — the
+    same marginal coverage guarantee as a Latin Hypercube (random
+    pairing), with no host RNG in the loop."""
+    d = xlimits.shape[0]
+    ks = jax.random.split(key, 2 * d)
+    cols = []
+    for j in range(d):
+        lo, hi = float(xlimits[j, 0]), float(xlimits[j, 1])
+        strata = jax.random.permutation(ks[2 * j], n).astype(jnp.float32)
+        u = jax.random.uniform(ks[2 * j + 1], (n,), jnp.float32)
+        cols.append(lo + (strata + u) / n * (hi - lo))
+    return jnp.stack(cols, axis=1)
+
+
+def _gumbel_topk_device(scores, n_keep: int, temp: float,
+                        uniform_frac: float, key):
+    """Device-side Gumbel top-k over ``p ∝ (1-u)·|s|^temp/Σ + u/N`` —
+    the same distribution :func:`importance_select` draws on the host,
+    with the same degenerate-score fallbacks (overflow/zero-sum →
+    uniform; zero rows floored so they stay reachable)."""
+    s = jnp.abs(scores)
+    n = s.shape[0]
+    smax = jnp.max(s)
+    s = jnp.where((smax > 0.0) & jnp.isfinite(smax), s / smax, s)
+    p = s ** temp
+    tot = jnp.sum(p)
+    p = jnp.where(jnp.isfinite(tot) & (tot > 0.0),
+                  (1.0 - uniform_frac) * p / tot + uniform_frac / n,
+                  1.0 / n)
+    p = jnp.maximum(p, jnp.finfo(jnp.float32).tiny)
+    keys = jnp.log(p) + jax.random.gumbel(key, (n,), jnp.float32)
+    _, idx = jax.lax.top_k(keys, n_keep)
+    return jnp.sort(idx)
+
+
+class DeviceResampler:
+    """Device-resident adaptive redraw: pool → score → select in ONE
+    jitted program, no host copy of pool or scores.
+
+    The pool is ``[current X_f ; n_fresh stratified candidates]``
+    (``n_fresh = max(pool_factor - 1, 1) × n_f``), so kept rows carry
+    their trained per-point λ through the redraw (:func:`carry_rows`).
+    Under a ``dist`` mesh every array keeps the training ``"data"``
+    sharding end to end; on multi-host meshes the jitted program consumes
+    the globally-sharded scores directly — no ``process_allgather``, no
+    per-process numpy assembly.
+
+    Calling :meth:`redraw` only *dispatches* the program (jax async
+    dispatch): the host regains control in ~ms while the device works,
+    which is what the fit loop's double-buffering hides behind the next
+    training chunk.  Determinism: everything is keyed on
+    ``fold_in(PRNGKey(seed), epoch)``, so a redraw is bit-reproducible
+    across reruns and processes."""
+
+    pipelined = True
+
+    def __init__(self, residual_fn: Callable, xlimits: np.ndarray, n_f: int,
+                 *, pool_factor: int = 4, temp: float = 1.0,
+                 uniform_frac: float = 0.1, seed: int = 0, like=None):
+        self.residual_fn = residual_fn
+        self.xlimits = np.asarray(xlimits, np.float64)
+        self.n_f = int(n_f)
+        self.temp = float(temp)
+        self.uniform_frac = float(uniform_frac)
+        self.seed = int(seed)
+        self.n_fresh = max(int(pool_factor) - 1, 1) * self.n_f
+        placement = getattr(like, "sharding", None)
+        if placement is not None and getattr(placement, "mesh", None) is not None:
+            n_dev = int(np.prod(placement.mesh.devices.shape))
+            if self.n_f % n_dev:
+                raise ValueError(
+                    f"n_f={n_f} must be divisible by the mesh device count "
+                    f"{n_dev} for resampling under dist=True")
+            self.placement = placement
+        else:
+            self.placement = None
+        self._redraw_jit = jax.jit(self._redraw_impl)
+
+    # -- the one jitted program ---------------------------------------- #
+    def _place(self, arr):
+        if self.placement is None:
+            return arr
+        return jax.lax.with_sharding_constraint(arr, self.placement)
+
+    def _redraw_impl(self, params, X_cur, epoch):
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        k_pool, k_sel = jax.random.split(key)
+        fresh = self._place(_stratified_pool(k_pool, self.n_fresh,
+                                             self.xlimits))
+        pool = self._place(jnp.concatenate([X_cur, fresh], axis=0))
+        f = self.residual_fn(params, pool)
+        parts = f if isinstance(f, tuple) else (f,)
+        scores = None
+        for part in parts:
+            a = jnp.abs(jnp.asarray(part, jnp.float32))
+            a = jnp.sum(a.reshape(a.shape[0], -1), axis=1)
+            scores = a if scores is None else scores + a
+        idx = _gumbel_topk_device(scores, self.n_f, self.temp,
+                                  self.uniform_frac, k_sel)
+        X_new = self._place(jnp.take(pool, idx, axis=0))
+        kept = idx < self.n_f
+        sel_mean = jnp.mean(jnp.take(scores, idx))
+        pool_mean = jnp.mean(scores)
+        stats = {
+            "kept_fraction": jnp.mean(kept.astype(jnp.float32)),
+            "score_gain": sel_mean / jnp.maximum(
+                pool_mean, jnp.finfo(jnp.float32).tiny),
+        }
+        return ResampleSwap(X_new, idx, kept, stats)
+
+    def redraw(self, params, X_cur, epoch: int) -> ResampleSwap:
+        """Dispatch one redraw (async — returns device futures)."""
+        return self._redraw_jit(params, X_cur, jnp.asarray(int(epoch)))
+
+    def lower_redraw(self, params, X_cur):
+        """The redraw program's ``Lowered`` (cost analysis without a
+        compile) — the score-pass FLOP pricing hook."""
+        return self._redraw_jit.lower(params, X_cur, jnp.asarray(0))
+
+
+def _carry_impl(rows, idx, kept, fresh_zero: bool, placement):
+    n_f = rows.shape[0]
+    g = jnp.take(rows, jnp.clip(idx, 0, n_f - 1), axis=0)
+    k = kept.reshape((-1,) + (1,) * (g.ndim - 1))
+    if fresh_zero:
+        fresh0 = jnp.zeros(g.shape[1:], g.dtype)
+    else:
+        n_kept = jnp.sum(kept)
+        mean_kept = (jnp.sum(jnp.where(k, g, 0.0), axis=0)
+                     / jnp.maximum(n_kept, 1).astype(g.dtype))
+        # adaptive SA-λ schedule (arXiv:2207.04084): fresh rows enter at
+        # the carried distribution's CURRENT mean — the self-supervision
+        # weight level training has adapted to — not the cold-start init
+        # (degenerate all-fresh redraw: the old set's mean)
+        fresh0 = jnp.where(n_kept > 0, mean_kept, jnp.mean(rows, axis=0))
+    new = jnp.where(k, g, fresh0)
+    mean_old = jnp.mean(rows)
+    drift = jnp.abs(jnp.mean(new) - mean_old) / jnp.maximum(
+        jnp.abs(mean_old), jnp.finfo(jnp.float32).tiny)
+    if placement is not None:
+        new = jax.lax.with_sharding_constraint(new, placement)
+    return new, drift
+
+
+_carry_jit = jax.jit(_carry_impl,
+                     static_argnames=("fresh_zero", "placement"))
+
+
+def carry_rows(rows, idx, kept, fresh_zero: bool = False):
+    """Carry per-point state through a :class:`DeviceResampler` redraw.
+
+    ``rows`` is any ``[n_f, ...]`` array row-aligned with the OLD
+    collocation set (per-point SA λ, or its λ-ascent Adam moments).  Kept
+    pool rows gather their trained values; fresh rows initialize at the
+    carried distribution's mean (``fresh_zero=True``: at zero — the
+    optimizer-moment rule: fresh points have no ascent history).  Runs
+    jitted so multi-host sharded λ never transit the host; the output
+    keeps the input's mesh sharding.  Returns ``(new_rows, drift)`` where
+    ``drift`` is the relative change of the mean — the λ-drift gauge."""
+    placement = getattr(rows, "sharding", None)
+    if placement is None or getattr(placement, "mesh", None) is None:
+        placement = None
+    return _carry_jit(rows, idx, kept, fresh_zero, placement)
+
+
+def gather_rows_multihost(X_global) -> np.ndarray:
+    """Full host copy of a multi-process sharded ``[N, d]`` array: each
+    process reads its addressable row slices and
+    :func:`_allgather_by_row` assembles the identical global array
+    everywhere (``np.asarray`` on a cross-host array is illegal)."""
+    n = int(X_global.shape[0])
+    local: dict[int, np.ndarray] = {}
+    for shard in X_global.addressable_shards:
+        start = shard.index[0].start or 0
+        local[start] = np.asarray(shard.data, np.float64)
+    out = _allgather_by_row(local, n)
+    return out.reshape((n,) + tuple(X_global.shape[1:]))
 
 
 def make_residual_resampler(residual_fn: Callable, xlimits: np.ndarray,

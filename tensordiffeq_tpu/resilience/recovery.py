@@ -14,6 +14,14 @@ only a diagnosis.  :class:`ResilientFit` is the treatment:
 2. **remedy** — apply the next rung of a configurable ladder, mildest
    first, cumulatively:
 
+   * ``resample_uniform`` — raise the adaptive-resampling uniform floor
+     (only on when the fit resamples; auto-prepended as the mildest rung
+     then): importance redraws concentrating onto a hot region shift the
+     trained point distribution, and that drift can destabilize the
+     minimax — a higher floor makes every SUBSEQUENT redraw explore more
+     uniformly, preventing the re-divergence instead of re-rolling it
+     back.  The bumped floor rides checkpoint meta, so a relaunch keeps
+     the calmer sampler;
    * ``lr_backoff``  — scale both learning rates down (default ×0.5);
    * ``lambda_reset``— reset SA-λ to their entry values (a saturated λ
      distribution is trained state; rollback alone restores the λ that
@@ -70,12 +78,16 @@ class ResilientFit:
         maximum rollback loss).
       max_retries: recoveries allowed per :meth:`fit` call before the
         divergence is re-raised.
-      remedies: the ladder — a sequence of ``"lr_backoff"`` /
-        ``"lambda_reset"`` / ``"grad_clip"`` names, ``(name, value)``
-        pairs to override the default strength (backoff factor / ignored /
-        clip norm), or callables ``remedy(solver, supervisor)`` for custom
-        rungs.  Applied cumulatively, one rung per recovery; a recovery
-        past the last rung re-applies it (``lr_backoff`` keeps halving).
+      remedies: the ladder — a sequence of ``"resample_uniform"`` /
+        ``"lr_backoff"`` / ``"lambda_reset"`` / ``"grad_clip"`` names,
+        ``(name, value)`` pairs to override the default strength (floor /
+        backoff factor / ignored / clip norm), or callables
+        ``remedy(solver, supervisor)`` for custom rungs.  Applied
+        cumulatively, one rung per recovery; a recovery past the last
+        rung re-applies it (``lr_backoff`` keeps halving).  When a
+        :meth:`fit` call resamples (``resample_every > 0``) and the
+        ladder is the default, ``"resample_uniform"`` is auto-prepended
+        as the mildest rung.
       lr_backoff: default backoff factor for ``lr_backoff`` rungs.
       grad_clip: default global-norm bound for the ``grad_clip`` rung.
       telemetry: a :class:`TrainingTelemetry` or
@@ -152,12 +164,22 @@ class ResilientFit:
             clip = self.grad_clip_norm if value is None else float(value)
             self._grad_clip_active = clip
             label = f"grad_clip({clip:g})"
+        elif rung == "resample_uniform":
+            # raise the redraw's uniform-mixture floor: less importance
+            # concentration, less point-distribution drift per redraw.
+            # Re-application escalates toward a fully uniform redraw.
+            cur = float(getattr(self.solver, "_resample_uniform_floor",
+                                0.0) or 0.0)
+            floor = float(value) if value is not None \
+                else min(1.0, max(0.3, 2.0 * cur))
+            self.solver._resample_uniform_floor = max(cur, floor)
+            label = f"resample_uniform({self.solver._resample_uniform_floor:g})"
         elif rung == "none":
             label = "none"
         else:
             raise ValueError(f"unknown remedy {rung!r}; expected "
-                             "'lr_backoff', 'lambda_reset', 'grad_clip', "
-                             "or a callable")
+                             "'resample_uniform', 'lr_backoff', "
+                             "'lambda_reset', 'grad_clip', or a callable")
         self._registry.counter("resilience.remedies", remedy=label).inc()
         self._event("remedy", f"applied remedy {label} "
                     f"(recovery {attempt}/{self.max_retries})",
@@ -190,6 +212,13 @@ class ResilientFit:
         from ..checkpoint import checkpoint_exists
 
         solver = self.solver
+        if int(fit_kw.get("resample_every", 0) or 0) > 0 \
+                and self.remedies == self.DEFAULT_REMEDIES:
+            # resampling active and the user kept the default ladder:
+            # prepend the mildest, cause-targeted rung — drift-induced
+            # instability is prevented at the sampler before the generic
+            # rungs (lr backoff, λ reset, clipping) touch the optimizer
+            self.remedies = ("resample_uniform",) + self.remedies
         self._lambdas0 = tree_copy(solver.lambdas)
         target_epochs = len(solver.losses) + int(tf_iter)
         target_newton = int(getattr(solver, "newton_done", 0)) \

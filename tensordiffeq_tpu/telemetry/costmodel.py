@@ -221,6 +221,7 @@ class StepCostModel:
         self.flops_per_step: Optional[float] = None
         self.bytes_per_step: Optional[float] = None
         self.basis: Optional[str] = None
+        self._extra_flops = 0.0
 
     def _scope(self):
         return self.registry.scope(phase=self.phase)
@@ -252,16 +253,26 @@ class StepCostModel:
         return {"flops_per_step": self.flops_per_step,
                 "bytes_per_step": self.bytes_per_step, "basis": self.basis}
 
+    def note_extra_flops(self, flops: Optional[float]):
+        """Credit off-step device work that executes inside the next timed
+        chunk's wall (a pipelined resample's pool-scoring pass): the FLOPs
+        join that chunk's numerator once, so ``cost.achieved_flops_per_s``
+        / ``cost.mfu`` stay honest instead of reading the redraw's device
+        time as idle training time."""
+        if flops:
+            self._extra_flops += float(flops)
+
     def observe_steps(self, n_steps: int, wall_s: float) -> Optional[float]:
         """Update the live throughput gauges from one timed chunk.
         Returns the MFU (None when unquotable)."""
         if self.flops_per_step is None or wall_s <= 0 or n_steps < 1:
             return None
-        rate = self.flops_per_step * n_steps / wall_s / self.n_chips
+        extra, self._extra_flops = self._extra_flops, 0.0
+        total = self.flops_per_step * n_steps + extra
+        rate = total / wall_s / self.n_chips
         scope = self._scope()
         scope.gauge("cost.achieved_flops_per_s").set(rate)
-        m = mfu(self.flops_per_step, n_steps / wall_s, self.n_chips,
-                self.peak)
+        m = mfu(total / n_steps, n_steps / wall_s, self.n_chips, self.peak)
         if m is not None:
             scope.gauge("cost.mfu").set(m)
         return m

@@ -228,6 +228,58 @@ class TrainingTelemetry:
             # point at the chunk's span tree
             self._last_step_trace = root.trace_id
 
+    def note_resample_flops(self, flops: Optional[float]):
+        """Credit a dispatched redraw's score-pass FLOPs to the chunk it
+        will execute behind (see :meth:`StepCostModel.note_extra_flops`) —
+        called at dispatch time, where the work lands on the device."""
+        if self._cost is not None and flops:
+            self._cost.note_extra_flops(flops)
+
+    def on_resample(self, phase: str, epoch: int, stall_s: float,
+                    stats: Optional[dict] = None, pipelined: bool = False,
+                    dispatched_epoch: Optional[int] = None,
+                    flops=(None, None)):
+        """One adaptive-collocation redraw (chunk boundary).  ``stall_s``
+        is the HOST-VISIBLE cost: the full synchronous call on the host
+        path, dispatch + swap bookkeeping on the pipelined device path
+        (pool scoring itself hides behind the intervening chunk).
+        ``stats`` carries the device path's drift diagnostics
+        (``kept_fraction`` / ``score_gain`` / ``lambda_drift``);
+        ``flops`` is the priced ``(flops, basis)`` of the score pass.
+        Emits the ``resample.*`` instruments, a ``resample`` event, and a
+        ``train.resample`` span on the active tracer."""
+        epoch = int(epoch) + self.epoch_offset
+        if dispatched_epoch is not None:
+            # same frame as `epoch`: a consumer reading the dispatch-to-
+            # swap gap must not see the restore/stage offset in one field
+            # and not the other
+            dispatched_epoch = int(dispatched_epoch) + self.epoch_offset
+        self.registry.counter("resample.redraws").inc()
+        self.registry.histogram("resample.stall_s").observe(float(stall_s))
+        stats = dict(stats or {})
+        if "kept_fraction" in stats:
+            self.registry.gauge("resample.kept_fraction").set(
+                stats["kept_fraction"])
+        if "score_gain" in stats:
+            self.registry.gauge("resample.score_gain").set(
+                stats["score_gain"])
+        if "lambda_drift" in stats:
+            self.registry.gauge("resample.lambda_drift").set(
+                stats["lambda_drift"])
+        score_flops, basis = (flops if isinstance(flops, (tuple, list))
+                              and len(flops) == 2 else (None, None))
+        if score_flops is not None:
+            self.registry.gauge("resample.score_flops").set(score_flops)
+        self.event("resample", phase=phase, epoch=epoch,
+                   stall_s=float(stall_s), pipelined=bool(pipelined),
+                   dispatched_epoch=dispatched_epoch,
+                   score_flops=score_flops, flops_basis=basis, **stats)
+        tr = active_tracer()
+        if tr is not None:
+            tr.record_span("train.resample", float(stall_s), parent=None,
+                           phase=phase, epoch=epoch,
+                           pipelined=bool(pipelined), **stats)
+
     def on_lambda_stats(self, epoch: int, lambdas: dict):
         stats = lambda_summaries(lambdas)
         if stats:

@@ -293,6 +293,75 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
     return run
 
 
+def _carry_point_state(trainables, opt_state, swap, n_points: int):
+    """Carry per-point SA state through a :class:`~tensordiffeq_tpu.ops.
+    resampling.DeviceResampler` redraw: per-point residual λ rows gather
+    through ``swap.idx`` (kept rows ride, fresh rows initialize from the
+    adaptive schedule — see :func:`..ops.resampling.carry_rows`), and the
+    λ-ascent Adam moments follow the same map with fresh rows restarting
+    at zero (a fresh point has no ascent history).  Only leaves on the
+    ``lambdas/residual`` path with a leading ``n_points`` axis are
+    touched — the moment remap walks the optimizer state by PATH, so a
+    BC λ (or a network layer) whose size coincides with ``n_points`` is
+    never mis-carried.  Returns ``(trainables, opt_state, drift)`` with
+    ``drift`` None when no per-point λ exist (nothing to carry)."""
+    from ..ops.resampling import carry_rows
+
+    def _is_rows(a):
+        return (a is not None and getattr(a, "ndim", 0) >= 1
+                and int(a.shape[0]) == n_points)
+
+    drift = None
+    new_terms = []
+    for lam in trainables["lambdas"]["residual"]:
+        if _is_rows(lam):
+            lam, d = carry_rows(lam, swap.idx, swap.kept)
+            drift = d if drift is None else jnp.maximum(drift, d)
+        new_terms.append(lam)
+    if drift is None:
+        return trainables, opt_state, None
+    trainables = {"params": trainables["params"],
+                  "lambdas": {**trainables["lambdas"],
+                              "residual": new_terms}}
+
+    def _on_residual_path(path):
+        return any(getattr(k, "key", None) == "residual" for k in path)
+
+    def remap(path, a):
+        if _on_residual_path(path) and _is_rows(a):
+            return carry_rows(a, swap.idx, swap.kept, fresh_zero=True)[0]
+        return a
+
+    inner = getattr(opt_state, "inner_states", None)
+    if isinstance(inner, dict) and "lam" in inner:
+        new_inner = dict(inner)
+        new_inner["lam"] = jax.tree_util.tree_map_with_path(
+            remap, inner["lam"])
+        opt_state = opt_state._replace(inner_states=new_inner)
+    return trainables, opt_state, drift
+
+
+def _adopt_points(X_new, X_f, batch_sz, mesh, best):
+    """Adopt a redrawn collocation set mid-fit — the bookkeeping BOTH
+    resample paths (synchronous host, pipelined device swap) share:
+    shape guard (the redraw must keep N_f so the compiled step is
+    reused), batch-buffer rebuild, and the best-model threshold reset —
+    losses before/after a redraw are measured on different point sets
+    (importance sampling deliberately picks harder points), so best-model
+    tracking must keep competing on the new set instead of freezing at a
+    pre-redraw snapshot.  Returns ``(X_f, X_batched, idx_batched,
+    best)``."""
+    if X_new.shape != X_f.shape:
+        raise ValueError(
+            f"resample redraw changed the collocation shape "
+            f"{X_f.shape} -> {X_new.shape}; the redraw must keep N_f so "
+            "the compiled step is reused")
+    X_batched, idx_batched, _ = make_batches(X_new, batch_sz, mesh=mesh,
+                                             verbose=False)
+    return X_new, X_batched, idx_batched, (best[0], jnp.asarray(jnp.inf),
+                                           best[2])
+
+
 def fit_adam(loss_fn: Callable,
              params,
              lambdas,
@@ -337,7 +406,15 @@ def fit_adam(loss_fn: Callable,
     collocation redraw (:mod:`..ops.resampling`) at the same chunk-boundary
     cadence.  ``X_new`` must keep the original shape/sharding, so the
     compiled runner and optimizer state carry straight on — only the batch
-    buffers are rebuilt.
+    buffers are rebuilt.  A *pipelined* hook (``resample_fn.pipelined``
+    True, exposing ``dispatch(params, X_f, epoch) -> ResampleSwap``)
+    is instead double-buffered: the redraw is DISPATCHED at the due
+    boundary (jax async dispatch — the host returns immediately) and its
+    buffers swap in at the NEXT boundary, so pool scoring executes behind
+    the intervening training chunk instead of serializing with it; the
+    swap also carries per-point residual λ (and their λ-ascent moments)
+    through the redraw (:func:`_carry_point_state`).  A redraw still
+    pending when the phase ends is discarded.
 
     ``state_hook(trainables, opt_state, epoch, best=...)`` +
     ``state_hook_every``: chunk-boundary access to the LIVE optimizer
@@ -420,6 +497,11 @@ def fit_adam(loss_fn: Callable,
     t0 = time.time()
     steps_done = 0
     data_s = 0.0  # batch-rebuild (resample) time attributed to step-time
+    # device-resident resample hooks (ops.resampling.DeviceResampler via
+    # the solver's wrapper) are double-buffered: `pending` holds a redraw
+    # dispatched at the previous chunk boundary, swapped in at the next
+    res_pipelined = bool(getattr(resample_fn, "pipelined", False))
+    pending = None
     pbar = progress_bar(tf_iter, desc="Adam") if verbose else None
     while steps_done < total_steps:
         n = int(min(chunk * n_batches, total_steps - steps_done))
@@ -459,25 +541,86 @@ def fit_adam(loss_fn: Callable,
                 if pbar is not None:
                     pbar.close()
                 raise
+        if pending is not None and steps_done >= total_steps:
+            # phase over: DISCARD the pending redraw (the docstring
+            # contract) — adopting it here would hand later phases
+            # (L-BFGS) a point set, and carry-reset fresh-row λ, that
+            # never trained a single Adam step.  The sync path never
+            # redraws at the final boundary for the same reason.
+            pending = None
+        if pending is not None:
+            # double-buffered swap: the redraw DISPATCHED at the previous
+            # boundary executed behind the chunk that just ran — adopt its
+            # point set now.  Host-visible cost is the swap bookkeeping
+            # (plus any residual device wait if the redraw outran the
+            # chunk), never the pool scoring itself.
+            swap, disp_epoch, disp_s = pending
+            pending = None
+            t_sw = time.perf_counter()
+            X_f, X_batched, idx_batched, best = _adopt_points(
+                swap.X_new, X_f, batch_sz, mesh, best)
+            trainables, opt_state, drift = _carry_point_state(
+                trainables, opt_state, swap, int(X_f.shape[0]))
+            on_swap = getattr(resample_fn, "on_swap", None)
+            if on_swap is not None:
+                on_swap(X_f)
+            want_stats = (telemetry is not None
+                          and hasattr(telemetry, "on_resample"))
+            if want_stats:
+                # this host transfer blocks until the redraw program has
+                # actually finished, so any residual device wait (the
+                # redraw outran the chunk) lands in the measured stall
+                # rather than leaking into the next chunk's timings
+                stats = {k: float(v) for k, v in swap.stats.items()}
+            stall = time.perf_counter() - t_sw
+            data_s += stall
+            if want_stats:
+                if drift is not None:
+                    stats["lambda_drift"] = float(drift)
+                flops_info = getattr(resample_fn, "flops_info", None)
+                telemetry.on_resample(
+                    "adam", cur_epochs, disp_s + stall, stats=stats,
+                    pipelined=True, dispatched_epoch=disp_epoch,
+                    flops=(flops_info() if flops_info is not None
+                           else (None, None)))
         if (resample_fn is not None and resample_every > 0
                 and steps_done < total_steps
                 and prev_epochs // resample_every != cur_epochs // resample_every):
-            t_data0 = time.perf_counter()
-            X_new = resample_fn(trainables["params"], cur_epochs)
-            if X_new.shape != X_f.shape:
-                raise ValueError(
-                    f"resample_fn changed the collocation shape "
-                    f"{X_f.shape} -> {X_new.shape}; the redraw must keep "
-                    "N_f so the compiled step is reused")
-            X_f = X_new
-            X_batched, idx_batched, _ = make_batches(
-                X_f, batch_sz, mesh=mesh, verbose=False)
-            data_s += time.perf_counter() - t_data0
-            # losses before/after a redraw are measured on different point
-            # sets (importance sampling deliberately picks harder points) —
-            # reset the threshold so best-model tracking keeps competing on
-            # the new set instead of freezing at a pre-redraw snapshot
-            best = (best[0], jnp.asarray(jnp.inf), best[2])
+            if res_pipelined:
+                # dispatch only: jax async dispatch returns in ~ms while
+                # the device scores the pool behind the NEXT chunk; the
+                # buffers swap at the next boundary (one-chunk staleness,
+                # the PACMANN-style pipelining trade).  The score pass's
+                # FLOPs are credited NOW — they execute inside the next
+                # chunk's wall, and the cost model must not read that
+                # device time as idle training time.  Pricing (a one-off
+                # ms-scale lowering) runs before the stall timer so the
+                # first redraw's measured stall stays honest.
+                flops_info = getattr(resample_fn, "flops_info", None)
+                if telemetry is not None and flops_info is not None \
+                        and hasattr(telemetry, "note_resample_flops"):
+                    telemetry.note_resample_flops(flops_info()[0])
+                t_data0 = time.perf_counter()
+                swap_next = resample_fn.dispatch(trainables["params"], X_f,
+                                                 cur_epochs)
+                disp_s = time.perf_counter() - t_data0
+                pending = (swap_next, cur_epochs, disp_s)
+                data_s += disp_s
+            else:
+                t_data0 = time.perf_counter()
+                X_new = resample_fn(trainables["params"], cur_epochs)
+                X_f, X_batched, idx_batched, best = _adopt_points(
+                    X_new, X_f, batch_sz, mesh, best)
+                stall = time.perf_counter() - t_data0
+                data_s += stall
+                if telemetry is not None and hasattr(telemetry,
+                                                     "on_resample"):
+                    flops_info = getattr(resample_fn, "flops_info", None)
+                    telemetry.on_resample(
+                        "adam", cur_epochs, stall, stats=None,
+                        pipelined=False,
+                        flops=(flops_info() if flops_info is not None
+                               else (None, None)))
         if lambda_update_fn is not None and steps_done < total_steps:
             # after any redraw, so NTK balances the points actually trained
             trainables["lambdas"] = lambda_update_fn(trainables["params"])

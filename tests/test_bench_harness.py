@@ -8,9 +8,39 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def resample_bench_proc():
+    """Start the --resample contract subprocess when the FIRST test of
+    this module runs and leave it cooking: the race (3 training arms,
+    ~4 min on the throttled CI host) overlaps the module's OTHER
+    subprocess contract tests (minimax / serving / fleet / elastic —
+    whose supervisors spend much of their wall in probe timeouts and
+    idle waits) instead of serializing after them.
+    ``test_resample_json_contract_on_cpu_fallback`` is deliberately the
+    LAST test in the file — it joins the process there (tier-1 wall
+    discipline: the suite brushes its 870 s gate on this host, so new
+    subprocess work must hide behind existing waits, not add to them)."""
+    cache_dir = tempfile.mkdtemp(prefix="bench_resample_cache_")
+    env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="560",
+               JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
+               PALLAS_AXON_POOL_IPS="", BENCH_TPU_CACHE_DIR=cache_dir)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
+         "resample"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    yield proc
+    if proc.poll() is None:  # join test skipped/failed early: reap it
+        proc.kill()
+        proc.communicate()
 
 
 def _load_bench():
@@ -493,6 +523,59 @@ def test_serving_json_contract_on_cpu_fallback(tmp_path):
     assert p["backend"] == "cpu"  # this env: the fallback really ran
 
 
+def test_resample_mode_registered():
+    """--resample is a first-class mode: distinct cache artifact, a
+    budget entry, and the --mode spelling maps onto it."""
+    bench = _load_bench()
+    assert bench.mode_name(["--resample"]) == "resample"
+    assert bench.tpu_cache_file(["--resample"]).endswith(
+        "BENCH_TPU_resample.json")
+
+
+def test_resample_payload_semantics():
+    """The race payload's honesty rules: speedup only when the adaptive
+    arm actually reached the gate; a fixed arm that never got there turns
+    the quote into a disclosed LOWER bound; fewer than three arms is a
+    partial (so a salvaged line can never be cached as the complete
+    sweep); the stall split compares steady-state (p50) per-redraw cost."""
+    bench = _load_bench()
+
+    def pay(arms):
+        return bench.resample_payload(arms, gate=0.1, n_f=2048,
+                                      budget=3000, resample_every=500)
+
+    assert pay({}) is None
+    fixed = {"epochs_to_gate": 3000, "rel_l2_final": 0.08, "wall_s": 30.0,
+             "redraws": 0}
+    host = {"epochs_to_gate": 2500, "rel_l2_final": 0.07, "wall_s": 33.0,
+            "redraws": 5,
+            "stall_s": {"mean": 0.08, "p50": 0.012, "p99": 0.09,
+                        "max": 0.09}}
+    dev = {"epochs_to_gate": 1500, "rel_l2_final": 0.06, "wall_s": 31.0,
+           "redraws": 5,
+           "stall_s": {"mean": 0.28, "p50": 0.0015, "p99": 1.4,
+                       "max": 1.4}}
+    p = pay({"fixed": fixed, "adaptive-host": host, "adaptive-device": dev})
+    assert p["value"] == 2.0 and p["vs_baseline"] == 2.0
+    assert "partial" not in p and "note" not in p
+    assert p["redraw_stall_reduction"] == 8.0  # p50 ratio, not mean
+    assert p["redraw_stall_s_p50"] == {"host": 0.012, "device": 0.0015}
+    assert p["unit"] == "x fewer steps to rel-L2 gate"
+    # fixed never reached the gate: quote vs the full budget, as a
+    # disclosed lower bound — never an invented epochs number
+    p = pay({"fixed": dict(fixed, epochs_to_gate=None),
+             "adaptive-host": host, "adaptive-device": dev})
+    assert p["value"] == 2.0 and "lower bound" in p["note"]
+    # the ADAPTIVE arm never reached it: no value, no fake win
+    p = pay({"fixed": fixed, "adaptive-host": host,
+             "adaptive-device": dict(dev, epochs_to_gate=None)})
+    assert p["value"] is None
+    # a salvaged mid-race line is marked partial (save_tpu_cache and the
+    # watcher's have_complete both refuse partials)
+    p = pay({"fixed": fixed})
+    assert "partial" in p and p["value"] is None
+
+
 def test_elastic_json_contract(tmp_path):
     """`bench.py --elastic` drives a REAL 2-process gloo cluster through a
     chaos host loss and reports the recovery SLO: one JSON line, exit 0,
@@ -564,3 +647,42 @@ def test_slo_gate_contract(tmp_path):
     assert r.returncode != 0
     verdict = json.loads(r.stdout.strip().splitlines()[-1])
     assert verdict["breaches"] == ["timed_out_fraction"]
+
+
+def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
+    """`python bench.py --mode resample` must emit ONE valid JSON line —
+    and the contract IS the acceptance bar (measured 2026-08-03 on this
+    host, deterministic by seed): (1) the device-resident adaptive arm
+    reaches the rel-L2 gate in measurably fewer optimizer steps than
+    fixed LHS at equal N_f (fixed never reaches it inside the budget, so
+    the quoted speedup is a disclosed lower bound — measured 1.212), and
+    (2) the pipelined redraw's per-redraw host-visible stall (p50) is a
+    fraction of the synchronous host path's (measured 75x on this host;
+    the >=3x bar leaves throttle headroom).  KEEP THIS TEST LAST IN THE
+    FILE: the subprocess was started by the module fixture before the
+    other contract tests ran, so joining here pays only the residual
+    wall, not the full race."""
+    out, err = resample_bench_proc.communicate(timeout=580)
+    assert resample_bench_proc.returncode == 0, err[-2000:]
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out  # supervisor: exactly one line
+    p = json.loads(lines[0])
+    assert p["unit"] == "x fewer steps to rel-L2 gate"
+    assert set(p["arms"]) == {"fixed", "adaptive-host", "adaptive-device"}
+    assert "partial" not in p  # all three arms completed
+    dev, fixed = p["arms"]["adaptive-device"], p["arms"]["fixed"]
+    # (1) the adaptive race: the device arm reached the gate, fixed LHS
+    # did not (or did later) — the headline speedup is real and >1
+    assert dev["redraws"] >= 1 and fixed["redraws"] == 0
+    assert dev["epochs_to_gate"] is not None
+    assert dev["rel_l2_final"] <= p["gate_rel_l2"] < fixed["rel_l2_final"]
+    assert isinstance(p["value"], (int, float)) and p["value"] >= 1.1
+    # the redraw concentrated onto high-residual points and kept part of
+    # the current set (the PACMANN-style pool)
+    assert dev["score_gain"] > 1.0 and 0.0 < dev["kept_fraction"] < 1.0
+    # (2) the stall split: steady-state (p50) per-redraw host-visible
+    # stall, pipelined device path vs synchronous host path
+    assert p["redraw_stall_s_p50"]["device"] < \
+        p["redraw_stall_s_p50"]["host"]
+    assert p["redraw_stall_reduction"] >= 3.0
+    assert p["backend"] == "cpu"  # this env: the fallback really ran

@@ -1,9 +1,14 @@
 """Adaptive collocation resampling (ops/resampling.py, beyond-reference).
 
-Covers the selection math, the end-to-end fit hook (shape/sharding
-preservation, compiled-step reuse), the per-point-λ guard, and the dist
-path on the 8-virtual-device mesh.
+Covers the selection math (host AND device implementations, plus their
+cross-implementation agreement), the end-to-end fit hook (shape/sharding
+preservation, compiled-step reuse, the pipelined device redraw), per-point
+λ carry through the redraw, the host path's per-point-λ guard, the dist
+path on the 8-virtual-device mesh, and the 8→4 topology portability of
+sampler + carried-λ state.
 """
+
+import warnings
 
 import numpy as np
 import pytest
@@ -12,7 +17,10 @@ import jax.numpy as jnp
 
 import tensordiffeq_tpu as tdq
 from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, dirichletBC, grad
-from tensordiffeq_tpu.ops.resampling import (_scores_multihost,
+from tensordiffeq_tpu.ops.resampling import (DeviceResampler,
+                                             _gumbel_topk_device,
+                                             _scores_multihost,
+                                             _stratified_pool, carry_rows,
                                              importance_select,
                                              make_residual_resampler,
                                              residual_scores)
@@ -46,6 +54,28 @@ def test_importance_select_survives_extreme_scores():
                             rng=rng)
     hot = (idx < 1_000).mean()
     assert hot > 0.4  # still concentrated, not the uniform fallback's ~10%
+
+
+def test_importance_select_zero_rows_stay_selectable():
+    """uniform_frac=0 with zero-residual rows: log(0) = -inf used to
+    poison those rows' keys — a numpy RuntimeWarning, and the rows became
+    PERMANENTLY unselectable (argpartition over tied -inf keys ignores
+    the Gumbel noise) even when n_keep exceeds the nonzero count.  The
+    clamped floor keeps every row reachable through its Gumbel draw."""
+    scores = np.zeros(100)
+    scores[:5] = 1.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the old path warned on log(0)
+        idx = importance_select(scores, 50, temp=1.0, uniform_frac=0.0,
+                                rng=np.random.default_rng(0))
+    assert len(np.unique(idx)) == 50  # n_keep > nonzero count still fills
+    # zero rows are reached THROUGH their Gumbel noise, not as a frozen
+    # tie-break set: different draws select different zero rows
+    z1 = set(importance_select(scores, 50, uniform_frac=0.0,
+                               rng=np.random.default_rng(1))) - set(range(5))
+    z2 = set(importance_select(scores, 50, uniform_frac=0.0,
+                               rng=np.random.default_rng(2))) - set(range(5))
+    assert z1 != z2
 
 
 def test_multihost_scoring_matches_gather_path(eight_devices):
@@ -107,6 +137,80 @@ def test_residual_scores_sums_outputs_and_tuples():
     assert np.allclose(residual_scores(res_tuple, None, X), [7.0, 3.0])
 
 
+def test_device_select_matches_host_distribution():
+    """Cross-implementation agreement at micro sizes: the device Gumbel
+    top-k draws the same distribution importance_select draws on the host
+    (normalize → temp power → uniform-floor mixture → Gumbel keys →
+    top-k without replacement), so swapping resample_device cannot change
+    what kind of point set training sees — only where it is computed.
+    RNG streams differ (numpy vs threefry), so the pin is distributional:
+    hot-region concentration over a few seeds, same coverage guarantees."""
+    import jax
+
+    scores = np.ones(4000)
+    scores[:400] = 50.0  # 10% of pool, ~98% of mass
+    hot_dev, hot_host = [], []
+    for seed in range(5):
+        idx_d = np.asarray(_gumbel_topk_device(
+            jnp.asarray(scores, jnp.float32), 800, 1.0, 0.1,
+            jax.random.PRNGKey(seed)))
+        assert len(np.unique(idx_d)) == 800  # without replacement
+        hot_dev.append(float((idx_d < 400).mean()))
+        idx_h = importance_select(scores, 800, temp=1.0, uniform_frac=0.1,
+                                  rng=np.random.default_rng(seed))
+        hot_host.append(float((idx_h < 400).mean()))
+    # each implementation concentrates, keeps cold coverage, and the two
+    # concentration rates agree within a few points of mass
+    for hot in (np.mean(hot_dev), np.mean(hot_host)):
+        assert 0.4 < hot < 1.0
+    assert abs(np.mean(hot_dev) - np.mean(hot_host)) < 0.05
+    # degenerate scores: device path falls back to uniform like the host
+    idx = np.asarray(_gumbel_topk_device(jnp.zeros(100, jnp.float32), 10,
+                                         1.0, 0.1, jax.random.PRNGKey(0)))
+    assert len(np.unique(idx)) == 10
+    # zero rows with uniform_frac=0 stay reachable (same clamped floor):
+    # only 400 nonzero rows, yet 800 distinct selections come back
+    z = jnp.asarray(np.where(scores > 1.0, 1.0, 0.0), jnp.float32)
+    idx = np.asarray(_gumbel_topk_device(z, 800, 1.0, 0.0,
+                                         jax.random.PRNGKey(1)))
+    assert len(np.unique(idx)) == 800
+
+
+def test_stratified_pool_has_lhs_marginals():
+    """The jax.random pool replacing host LHS keeps the Latin-Hypercube
+    marginal guarantee: every dimension places exactly one sample per
+    stratum (random pairing across dimensions), inside the box."""
+    import jax
+
+    xl = np.array([[-1.0, 1.0], [0.0, 2.0]])
+    n = 64
+    X = np.asarray(_stratified_pool(jax.random.PRNGKey(0), n,
+                                    jnp.asarray(xl)))
+    assert X.shape == (n, 2)
+    for j, (lo, hi) in enumerate(xl):
+        assert X[:, j].min() >= lo and X[:, j].max() <= hi
+        strata = np.floor((X[:, j] - lo) / (hi - lo) * n).astype(int)
+        assert len(np.unique(np.clip(strata, 0, n - 1))) == n
+
+
+def test_carry_rows_gathers_kept_and_schedules_fresh():
+    """λ-carry through a redraw: kept pool rows gather their trained
+    values; fresh rows initialize at the carried distribution's mean (the
+    adaptive SA-λ schedule) or at zero for optimizer moments."""
+    rows = jnp.asarray([[1.0], [2.0], [3.0], [4.0]])
+    idx = jnp.asarray([0, 2, 5, 7])  # pool indices; < 4 means kept
+    kept = idx < 4
+    new, drift = carry_rows(rows, idx, kept)
+    np.testing.assert_allclose(np.asarray(new), [[1.0], [3.0], [2.0], [2.0]])
+    np.testing.assert_allclose(float(drift), abs(2.0 - 2.5) / 2.5, rtol=1e-6)
+    new0, _ = carry_rows(rows, idx, kept, fresh_zero=True)
+    np.testing.assert_allclose(np.asarray(new0), [[1.0], [3.0], [0.0], [0.0]])
+    # degenerate all-fresh redraw: schedule falls back to the OLD set's mean
+    all_fresh = jnp.asarray([4, 5, 6, 7])
+    newf, _ = carry_rows(rows, all_fresh, all_fresh < 4)
+    np.testing.assert_allclose(np.asarray(newf), np.full((4, 1), 2.5))
+
+
 def _burgers_solver(n_f=600, dist=False, adaptive=None):
     domain = DomainND(["x", "t"], time_var="t")
     domain.add("x", [-1.0, 1.0], 64)
@@ -160,18 +264,167 @@ def test_fit_with_resampling_trains_and_swaps_points():
     solver.fit(tf_iter=0, newton_iter=10)
 
 
-def test_resampling_rejects_per_point_lambdas():
-    n_f = 600
+def test_pipelined_redraw_pending_at_phase_end_is_discarded():
+    """A pipelined redraw dispatched at the LAST due boundary has no
+    training chunk left to hide behind: adopting it would hand L-BFGS a
+    point set (and carry-reset fresh-row λ) that never trained an Adam
+    step.  The fit loop discards it — the documented contract, and the
+    behavior the synchronous path gets from its steps-done guard."""
+    from tensordiffeq_tpu.telemetry import MetricsRegistry, TrainingTelemetry
+
+    solver = _burgers_solver()
+    X0 = np.asarray(solver.X_f).copy()
+    reg = MetricsRegistry()
+    tele = TrainingTelemetry(logger=None, registry=reg, log_every=0,
+                             grad_norm=False)
+    # chunk=10, resample_every=30, tf_iter=40: the one dispatch fires at
+    # epoch 30 and its swap boundary IS the phase end
+    solver.fit(tf_iter=40, newton_iter=0, chunk=10, resample_every=30,
+               resample_seed=3, telemetry=tele)
+    np.testing.assert_array_equal(X0, np.asarray(solver.X_f))
+    assert reg.as_dict()["counters"].get("resample.redraws", 0) == 0
+
+
+def _sa_burgers_solver(n_f=600, dist=False, seed=0):
     rng = np.random.RandomState(0)
-    solver = _burgers_solver(
-        n_f=n_f,
+    return _burgers_solver(
+        n_f=n_f, dist=dist,
         adaptive=dict(Adaptive_type=1,
                       dict_adaptive={"residual": [True],
                                      "BCs": [False, False, False]},
                       init_weights={"residual": [rng.rand(n_f, 1)],
                                     "BCs": [None, None, None]}))
+
+
+def test_host_path_rejects_per_point_lambdas():
+    """resample_device=False (the host fallback) still raises under
+    Adaptive_type=1: its pool is entirely fresh, so trained λ rows have
+    no points to ride.  The DEVICE path (the default) lifts this — see
+    test_device_resample_carries_per_point_lambdas."""
+    solver = _sa_burgers_solver()
     with pytest.raises(ValueError, match="per-point"):
-        solver.fit(tf_iter=10, resample_every=5)
+        solver.fit(tf_iter=10, resample_every=5, resample_device=False)
+
+
+def test_device_resample_carries_per_point_lambdas():
+    """The acceptance path: Adaptive_type=1 trains WITH resample_every>0
+    on the device-resident redraw — kept rows carry their trained λ,
+    fresh rows initialize from the adaptive schedule — and the redraw's
+    drift diagnostics land in telemetry (resample.* gauges + the
+    train.resample accounting)."""
+    from tensordiffeq_tpu.telemetry import MetricsRegistry, TrainingTelemetry
+
+    solver = _sa_burgers_solver()
+    X0 = np.asarray(solver.X_f).copy()
+    lam0 = np.asarray(solver.lambdas["residual"][0]).copy()
+    reg = MetricsRegistry()
+    tele = TrainingTelemetry(logger=None, registry=reg, log_every=0)
+    solver.fit(tf_iter=60, newton_iter=0, chunk=10, resample_every=20,
+               resample_seed=3, telemetry=tele)
+    assert len(solver.losses) == 60
+    assert solver.losses[-1]["Total Loss"] < solver.losses[0]["Total Loss"]
+    assert not np.allclose(X0, np.asarray(solver.X_f))  # really swapped
+    lam = np.asarray(solver.lambdas["residual"][0])
+    assert lam.shape == lam0.shape and np.isfinite(lam).all()
+    assert not np.allclose(lam, lam0)  # λ kept training through redraws
+    snap = reg.as_dict()
+    assert snap["counters"].get("resample.redraws", 0) >= 1
+    gauges = snap["gauges"]
+    assert 0.0 <= gauges["resample.kept_fraction"] <= 1.0
+    assert gauges["resample.score_gain"] > 0.0
+    assert gauges["resample.lambda_drift"] >= 0.0
+    # L-BFGS continues on the resampled set with the carried λ
+    solver.fit(tf_iter=0, newton_iter=10)
+
+
+def test_device_redraw_sharded_matches_unsharded(eight_devices):
+    """Bit-level single-host agreement: the SAME redraw program under the
+    8-device "data" sharding selects the SAME points/indices as the
+    unsharded run — device placement changes where the pool is scored,
+    never which points training sees."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    solver = _burgers_solver(n_f=640)
+    X = jnp.asarray(np.asarray(solver.X_f), jnp.float32)
+    r1 = DeviceResampler(solver._residual_jit, solver.domain.xlimits, 640,
+                         seed=5)
+    s1 = r1.redraw(solver.params, X, 100)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    X_sh = jax.device_put(X, sharding)
+    r2 = DeviceResampler(solver._residual_jit, solver.domain.xlimits, 640,
+                         seed=5, like=X_sh)
+    s2 = r2.redraw(solver.params, X_sh, 100)
+    np.testing.assert_array_equal(np.asarray(s1.idx), np.asarray(s2.idx))
+    np.testing.assert_array_equal(np.asarray(s1.X_new), np.asarray(s2.X_new))
+    assert s2.X_new.sharding.is_equivalent_to(sharding, 2)
+    # the redraw concentrated: selected mean |f| beats the pool mean
+    assert float(s1.stats["score_gain"]) > 1.0
+    # determinism: the same (seed, epoch) redraws bit-identically
+    s3 = r1.redraw(solver.params, X, 100)
+    np.testing.assert_array_equal(np.asarray(s1.idx), np.asarray(s3.idx))
+
+
+def test_sa_resample_state_restores_across_topology_change(tmp_path,
+                                                           eight_devices):
+    """Acceptance pin: an SA run (per-point λ) WITH device-resident
+    resampling checkpoints on the 8-device mesh and restores onto a
+    4-device slice (the host-loss shape), the resampled X_f + carried λ
+    riding the per-shard topology-portable layout — and the resumed
+    trajectory is destination-INDEPENDENT: the 4-device resume matches
+    the 8-device resume epoch for epoch (the redraw keys on
+    (seed, epoch) and the device selection is sharding-invariant, so
+    global state alone determines the trajectory).  The supervisor's
+    resample_uniform floor rides checkpoint meta through the re-shard."""
+    import json
+
+    ck = str(tmp_path / "ck")
+    s_a = _sa_burgers_solver(n_f=640, dist=True)
+    s_a.fit(tf_iter=20, newton_iter=0, chunk=5, resample_every=10,
+            resample_seed=3)
+    s_a._resample_uniform_floor = 0.25  # as a supervisor rung would set
+    s_a.save_checkpoint(ck, sharded=True)
+    lam_saved = np.asarray(s_a.lambdas["residual"][0])
+    X_saved = np.asarray(s_a.X_f)
+    meta = json.load(open(tmp_path / "ck" / "tdq_meta.json"))
+    assert meta["meta"]["resample_uniform_floor"] == 0.25
+    # the per-shard manifest records GLOBAL shapes for X_f and λ — the
+    # topology-portable contract
+    shapes = [tuple(v["global_shape"])
+              for v in meta["sharded"]["leaves"].values()]
+    assert (640, 2) in shapes and (640, 1) in shapes
+
+    def resume(dist):
+        s = _sa_burgers_solver(n_f=640, dist=dist)
+        s.restore_checkpoint(ck)
+        # restored state matches the save bit-for-bit across the re-shard
+        np.testing.assert_array_equal(np.asarray(s.X_f), X_saved)
+        np.testing.assert_array_equal(
+            np.asarray(s.lambdas["residual"][0]), lam_saved)
+        assert s._resample_uniform_floor == 0.25
+        s.fit(tf_iter=20, newton_iter=0, chunk=5, resample_every=10,
+              resample_seed=3)
+        return s
+
+    s4 = resume(4)
+    assert len(s4.X_f.sharding.device_set) == 4
+    s8 = resume(True)
+    assert len(s8.X_f.sharding.device_set) == 8
+    l4 = np.array([d["Total Loss"] for d in s4.losses])
+    l8 = np.array([d["Total Loss"] for d in s8.losses])
+    np.testing.assert_allclose(
+        l4, l8, rtol=1e-4,
+        err_msg="8->4 re-shard diverged from the 8->8 resume: the "
+        "resampled trajectory must depend on global state only")
+    # both resumes redrew (the restored floor feeds the new sampler) and
+    # λ kept training through the carried redraws
+    assert not np.allclose(np.asarray(s4.X_f), X_saved)
+    np.testing.assert_allclose(np.asarray(s4.lambdas["residual"][0]),
+                               np.asarray(s8.lambdas["residual"][0]),
+                               rtol=1e-4, atol=1e-6)
+    assert not np.allclose(np.asarray(s4.lambdas["residual"][0]), lam_saved)
 
 
 def test_resampling_composes_with_ntk():

@@ -125,6 +125,72 @@ def test_chaos_off_training_is_bit_identical():
         np.asarray(sup.lambdas["residual"][0]))
 
 
+def test_chaos_off_resampled_fit_is_bit_identical(tmp_path):
+    """The no-op contract extends to the pipelined device-resident redraw
+    WITH per-point SA-λ carried through it: a supervised resampled run
+    with no chaos active produces the SAME bits as a plain resampled fit
+    — checkpointing hooks, the auto-prepended resample_uniform rung, and
+    telemetry change nothing numerically."""
+    kw = dict(tf_iter=20, newton_iter=0, chunk=10, resample_every=10,
+              resample_seed=3)
+    plain = make_solver()
+    plain.fit(**kw)
+
+    sup = make_solver()
+    rf = ResilientFit(sup, str(tmp_path / "ck"), checkpoint_every=10)
+    rf.fit(**kw)
+    # resampling active + default ladder: the sampler rung leads it
+    assert rf.remedies[0] == "resample_uniform"
+    assert len(sup.losses) == len(plain.losses) == 20
+    np.testing.assert_array_equal(np.asarray(plain.X_f),
+                                  np.asarray(sup.X_f))
+    for a, b in zip(leaves(plain.params), leaves(sup.params)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        np.asarray(plain.lambdas["residual"][0]),
+        np.asarray(sup.lambdas["residual"][0]))
+
+
+def test_resample_uniform_remedy_rung_prevents_redraw_drift(tmp_path):
+    """A divergence in a RESAMPLED fit walks the sampler rung first: the
+    supervisor bumps the solver's redraw uniform floor (prevention at the
+    cause — subsequent redraws explore more uniformly — instead of only
+    rolling back the symptom), the rung escalates on re-application, and
+    the remedy counter carries its label."""
+    from tensordiffeq_tpu.telemetry import MetricsRegistry
+
+    s = make_solver()
+    reg = MetricsRegistry()
+    from tensordiffeq_tpu.telemetry import TrainingTelemetry
+    tele = TrainingTelemetry(logger=None, registry=reg, log_every=0,
+                             grad_norm=False)
+    with Chaos(nan_epoch=15, nan_repeats=2, seed=0) as c:
+        rf = ResilientFit(s, str(tmp_path / "ck"), checkpoint_every=10,
+                          max_retries=3, telemetry=tele)
+        rf.fit(tf_iter=40, newton_iter=0, chunk=10, resample_every=10)
+    assert c.fired["nan"] == 2
+    assert rf.recoveries == 2
+    # rung 1: floor bumped to the 0.3 default; rung 2 (lr_backoff) left it
+    assert s._resample_uniform_floor == 0.3
+    assert len(s.losses) == 40
+    assert np.isfinite(s.losses[-1]["Total Loss"])
+    counters = reg.as_dict()["counters"]
+    assert counters.get(
+        "resilience.remedies{remedy=resample_uniform(0.3)}") == 1
+    # a custom ladder is NOT silently rewritten
+    rf2 = ResilientFit(make_solver(), str(tmp_path / "ck2"),
+                       remedies=("grad_clip",))
+    rf2.fit(tf_iter=10, newton_iter=0, chunk=10, resample_every=10)
+    assert rf2.remedies == ("grad_clip",)
+    # re-application escalates: 0.3 -> 0.6 -> ... capped at 1.0
+    s3 = make_solver()
+    rf3 = ResilientFit(s3, str(tmp_path / "ck3"),
+                       remedies=("resample_uniform",))
+    for expect in (0.3, 0.6, 1.0, 1.0):
+        rf3._apply_remedy(attempt=1)
+        assert s3._resample_uniform_floor == expect
+
+
 def test_supervisor_detects_hung_host_via_stale_heartbeat(tmp_path):
     """A host whose PROCESS lives but whose heartbeat goes stale (the
     wedged-coordinator shape) must be declared lost and the job must

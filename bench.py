@@ -53,6 +53,10 @@ Modes:
                 gate for fixed LHS vs adaptive (host path) vs adaptive +
                 device-resident pipelined redraw, plus the per-redraw
                 host-visible stall split
+  --lint        not a measurement: the tdqlint static-analysis gate
+                (tensordiffeq_tpu.analysis AST rules) over the package +
+                bench.py — one verdict line, exit nonzero on findings
+                (exempt from exit-0-always, like --slo)
   --slo TARGET  not a measurement: evaluate the default SLO set
                 (telemetry.slo) against an existing runs/<dir> or a bench
                 payload JSON file, print one machine-readable verdict
@@ -1938,6 +1942,18 @@ def bench_elastic():
     return payload
 
 
+def lint_verdict():
+    """``bench.py --lint`` body: the tdqlint AST pass over the package +
+    bench.py (tensordiffeq_tpu.analysis), as a machine-readable verdict
+    dict; the caller turns ``ok`` into the exit code."""
+    from tensordiffeq_tpu.analysis import run_analysis
+    findings, modules = run_analysis()
+    return {"metric": "tdqlint static analysis (AST rules)",
+            "ok": not findings, "value": len(findings), "unit": "findings",
+            "files_scanned": len(modules),
+            "findings": [f.format() for f in findings]}
+
+
 def slo_verdict(target):
     """``bench.py --slo`` body: the default
     :class:`tensordiffeq_tpu.telemetry.SLOSet` verdict for ``target`` — a
@@ -2233,6 +2249,13 @@ def main():
                          "runs/<dir> or bench payload JSON and exit nonzero "
                          "on breach (machine-readable verdict line; a CI "
                          "gate, not a measurement mode)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the tdqlint static-analysis gate (AST rules "
+                         "over the package + bench.py; see "
+                         "tensordiffeq_tpu/analysis/) and exit nonzero on "
+                         "findings — a CI gate, not a measurement mode; "
+                         "like --slo it is exempt from the exit-0-always "
+                         "contract")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic recovery SLO: run a real 2-process gloo "
                          "cluster, hard-kill one host via chaos "
@@ -2252,6 +2275,15 @@ def main():
     args = ap.parse_args()
     if args.mode and args.mode != "default":
         setattr(args, args.mode, True)
+
+    if args.lint:
+        # CI gate over the SOURCE: no probe, no worker, no cache — and
+        # deliberately NOT exit-0-always (the finding IS the signal).
+        # One machine-readable verdict line, same shape discipline as
+        # --slo; the findings ride in full so CI logs are actionable.
+        verdict = lint_verdict()
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 3)
 
     if args.slo:
         # CI gate over captured evidence: no probe, no worker, no cache —

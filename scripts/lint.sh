@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# tdqlint local entry point: the same AST pass tier-1 gates on
+# (tests/test_lint_clean.py) and bench.py --lint wires into CI.
+#
+#   scripts/lint.sh                # AST rules over the package + bench.py
+#   scripts/lint.sh --jaxpr        # + the jaxpr-level hot-program audit
+#   scripts/lint.sh --list-rules   # rule ids + one-line docs
+#
+# Exit codes: 0 clean, 1 findings, 2 usage error.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m tensordiffeq_tpu.analysis "$@"

@@ -1,0 +1,48 @@
+"""tdqlint — the JAX-aware static-analysis engine (PR 12).
+
+One AST walk over the package, ~8 pluggable rules, one suppression
+syntax, one CI entry point::
+
+    python -m tensordiffeq_tpu.analysis          # AST pass, exit 1 on findings
+    python -m tensordiffeq_tpu.analysis --jaxpr  # + the jaxpr-level audit
+    scripts/lint.sh                              # the local alias
+
+Each rule encodes an invariant a previous PR learned the hard way — no
+host sync in the pipelined hot path (PR 10), no PRNG key reuse across
+redraws (PR 10), f32-max dtype discipline in the bf16 fused paths
+(PR 9), typed structured errors with the trace_id attach hook (PR 7),
+donated-buffer hygiene (PR 5/9), no bare print (PR 4), metrics-catalog
+drift (PR 7), and pallas interpret-mode coverage (PR 9).  See
+docs/design.md for the rationale and docs/api.md for usage.
+
+Suppress a deliberate violation with ``# tdq: allow[rule-id] reason`` —
+a suppression without a reason fails, and a suppression matching no
+finding fails (``unused-suppression``), so the allow list cannot rot.
+
+This package is stdlib-only at import time: the fixture tests and the
+CI gate never pay a jax import.  The jaxpr/HLO-level pass
+(:mod:`.jaxpr_audit`) imports jax lazily inside its functions.
+"""
+
+from .engine import (Context, Finding, ParsedModule, Rule,  # noqa: F401
+                     iter_source_files, parse_module, repo_root_default,
+                     run_rules)
+from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401
+
+
+def run_analysis(repo_root=None, select=None, files=None):
+    """Run the AST pass; returns ``(findings, modules)``.
+
+    ``select``: iterable of rule ids (default: every rule).  ``files``:
+    explicit file list (default: the package + bench.py).
+    """
+    if select is None:
+        rules = ALL_RULES
+    else:
+        unknown = [s for s in select if s not in RULES_BY_ID]
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {unknown}; "
+                             f"known: {sorted(RULES_BY_ID)}")
+        rules = tuple(RULES_BY_ID[s] for s in select)
+    return run_rules(rules, repo_root=repo_root, files=files,
+                     known_rules=frozenset(RULES_BY_ID))

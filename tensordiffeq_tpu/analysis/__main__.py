@@ -1,0 +1,64 @@
+"""CLI: ``python -m tensordiffeq_tpu.analysis`` (alias ``tdqlint``).
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.  Output is one
+``file:line rule-id message`` per finding — editor/CI friendly.
+"""
+
+import argparse
+import sys
+
+from . import ALL_RULES, run_analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tdqlint",
+        description="JAX-aware static analysis for tensordiffeq_tpu: "
+                    "the invariants PRs 4-10 learned the hard way, as "
+                    "one checked-in pass")
+    ap.add_argument("files", nargs="*",
+                    help="files to lint (default: the whole package "
+                         "+ bench.py)")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule ids + one-line docs and exit")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="also run the jaxpr-level audit over the hot-"
+                         "program registry (imports jax; slower)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:28s} {rule.doc}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    try:
+        findings, _ = run_analysis(select=select,
+                                   files=args.files or None)
+    except ValueError as e:
+        print(f"tdqlint: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+
+    n_jaxpr_bad = 0
+    if args.jaxpr:
+        from .jaxpr_audit import audit_all
+        for report in audit_all():
+            status = "ok" if report.ok else "FLAGGED"
+            print(f"jaxpr-audit {report.name}: {status} "
+                  f"({report.summary()})")
+            if not report.ok:
+                n_jaxpr_bad += 1
+
+    if findings or n_jaxpr_bad:
+        total = len(findings) + n_jaxpr_bad
+        print(f"tdqlint: {total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

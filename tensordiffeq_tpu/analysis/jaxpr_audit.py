@@ -1,0 +1,228 @@
+"""The jaxpr/HLO-level audit: "zero transfers inside the step" as a
+checked property, not a PERF.md claim.
+
+The AST rules catch host syncs a human *wrote*; this pass catches the
+ones a program *contains* after tracing — host callbacks
+(``pure_callback``/``io_callback``/``debug_callback``/…, which lower to
+``custom_call``-based host round-trips) and device→host transfers
+(``device_put`` onto a host memory kind) hiding anywhere in the traced
+call graph of a registered hot program, including code the AST walk
+cannot see (closures built at runtime, library internals).
+
+A small registry of hot programs is traced at micro sizes — tracing
+costs milliseconds and needs no XLA compile (the same
+``Lowered``-not-``compile`` trick PR 7's cost model uses):
+
+* ``fused-minimax-step`` — the full fused SA step: loss value + weight/
+  bias cotangents + the per-point ∂loss/∂w (λ-ascent direction) + the
+  point cotangent (PR 9's 2.36× win; one stray ``float(tracer)`` here
+  and the whole fusion falls apart).
+* ``device-resampler`` — PR 10's one-program pool→score→select redraw
+  (the 163ms→1.8ms stall win is exactly "no host round-trip here").
+* ``serving-u`` / ``serving-residual`` — the engine's per-kind bucket
+  programs (the fleet's zero-request-time-compile path).
+
+jax is imported lazily inside functions: importing this module (or the
+rest of :mod:`tensordiffeq_tpu.analysis`) stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+#: jaxpr primitives that round-trip through the host
+HOST_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+    "debug_print",
+}
+
+#: custom_call targets in lowered StableHLO that mean a host hop
+_HOST_TARGET = re.compile(
+    r"callback|host|infeed|outfeed|xla_python|py_func", re.IGNORECASE)
+_CUSTOM_CALL = re.compile(
+    r'custom_call[^\n]*?call_target_name\s*=\s*"([^"]+)"')
+_SEND_RECV = re.compile(r"stablehlo\.(send|recv)\b")
+
+
+@dataclass
+class AuditReport:
+    """One hot program's verdict."""
+    name: str
+    callbacks: list = field(default_factory=list)   # jaxpr host prims
+    transfers: list = field(default_factory=list)   # device->host moves
+    custom_calls: list = field(default_factory=list)  # flagged HLO targets
+
+    @property
+    def ok(self) -> bool:
+        return not (self.callbacks or self.transfers or self.custom_calls)
+
+    def summary(self) -> str:
+        if self.ok:
+            return "0 host callbacks, 0 device->host transfers"
+        parts = []
+        if self.callbacks:
+            parts.append(f"host callbacks: {sorted(set(self.callbacks))}")
+        if self.transfers:
+            parts.append(f"transfers: {sorted(set(self.transfers))}")
+        if self.custom_calls:
+            parts.append(
+                f"host custom_calls: {sorted(set(self.custom_calls))}")
+        return "; ".join(parts)
+
+
+def _scan_jaxpr(jaxpr, report: AuditReport) -> None:
+    """Recursively collect host-hop primitives from a jaxpr (descending
+    into every sub-jaxpr carried in eqn params: scan/cond/pjit bodies,
+    custom_vjp branches, …)."""
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in HOST_CALLBACK_PRIMS:
+            report.callbacks.append(prim)
+        elif prim == "device_put":
+            # flag only host-bound placements: a sharding constraint or
+            # device->device move is legal inside a step
+            for dst in (eqn.params.get("devices") or []):
+                kind = getattr(dst, "memory_kind", None)
+                if kind is not None and "host" in str(kind):
+                    report.transfers.append(f"device_put->{kind}")
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                _scan_jaxpr(sub, report)
+
+
+def _sub_jaxprs(val):
+    """Jaxprs carried in an eqn param — duck-typed (Jaxpr has ``eqns``,
+    ClosedJaxpr wraps one in ``.jaxpr``) so no private jax imports."""
+    if hasattr(val, "eqns"):
+        yield val
+    elif hasattr(val, "jaxpr") and hasattr(val.jaxpr, "eqns"):
+        yield val.jaxpr
+    elif isinstance(val, (tuple, list)):
+        for v in val:
+            yield from _sub_jaxprs(v)
+
+
+def _scan_stablehlo(text: str, report: AuditReport) -> None:
+    for m in _CUSTOM_CALL.finditer(text):
+        target = m.group(1)
+        if _HOST_TARGET.search(target):
+            report.custom_calls.append(target)
+    for m in _SEND_RECV.finditer(text):
+        report.transfers.append(f"stablehlo.{m.group(1)}")
+
+
+# --------------------------------------------------------------------- #
+# the hot-program registry (micro sizes: tracing only, no compile)
+# --------------------------------------------------------------------- #
+
+def _micro_net(seed=0, widths=(8, 8), n_out=1):
+    import jax
+    import jax.numpy as jnp
+
+    from ..networks import neural_net
+    net = neural_net([2, *widths, n_out])
+    params = net.init(jax.random.PRNGKey(seed), jnp.zeros((1, 2)))
+    return net, params
+
+
+def _minimax_program():
+    """The fused SA minimax step: value + every cotangent it emits."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.derivatives import grad
+    from ..ops.fused import analyze_f_model
+    from ..ops.pallas_minimax import build_minimax_sq_fn
+    from ..ops.taylor import extract_mlp_layers
+
+    net, params = _micro_net()
+    layers = extract_mlp_layers(params)
+    shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
+
+    def f_model(u, x, t):  # AC-type: primal + u_t + u_xx
+        return (grad(u, "t")(x, t) - 0.05 * grad(grad(u, "x"), "x")(x, t)
+                + u(x, t) ** 3 - u(x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 1)
+    sq = build_minimax_sq_fn(f_model, ("x", "t"), 1, reqs, shapes)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(16, 2) * 0.5, jnp.float32)
+    w = jnp.asarray(rng.rand(16, 1), jnp.float32)
+
+    def step(layers, w, X):
+        val, vjp = jax.vjp(sq, layers, w, X)
+        g_layers, g_w, g_X = vjp(jnp.ones((), val.dtype))
+        return val, g_layers, g_w, g_X
+
+    return step, (layers, w, X)
+
+
+def _resampler_program():
+    """PR 10's one-program pool->score->select redraw."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.resampling import DeviceResampler
+
+    net, params = _micro_net(seed=1)
+
+    def residual_fn(params, X):
+        return net.apply(params, X)
+
+    xlimits = np.array([[-1.0, 1.0], [0.0, 1.0]])
+    r = DeviceResampler(residual_fn, xlimits, n_f=16, pool_factor=2)
+    X = jnp.zeros((16, 2), jnp.float32)
+    return r._redraw_impl, (params, X, jnp.asarray(0))
+
+
+def _serving_program(kind: str):
+    """The engine's per-kind bucket program (what each rung jits)."""
+    import jax.numpy as jnp
+
+    from ..ops.derivatives import grad
+    from ..serving.surrogate import Surrogate
+
+    def builder():
+        net, params = _micro_net(seed=2)
+
+        def f_model(u, x, t):
+            return grad(u, "t")(x, t) + u(x, t) * grad(u, "x")(x, t)
+
+        sur = Surrogate(net, params, ("x", "t"), f_model=f_model)
+        eng = sur.engine(min_bucket=32)
+        batched = eng.make_batched(kind)()
+        X = jnp.zeros((32, 2), jnp.float32)
+        return batched, (params, X)
+    return builder
+
+
+HOT_PROGRAMS = {
+    "fused-minimax-step": _minimax_program,
+    "device-resampler": _resampler_program,
+    "serving-u": _serving_program("u"),
+    "serving-residual": _serving_program("residual"),
+}
+
+
+def audit(name: str) -> AuditReport:
+    """Trace + lower one registered hot program and scan for host hops.
+
+    Trace-level (``make_jaxpr``) catches callback/transfer *primitives*;
+    lowering to StableHLO text (``Lowered.as_text`` — still no XLA
+    compile) catches ``custom_call``-based host hooks the primitives
+    lower into.  Both must be clean."""
+    import jax
+
+    fn, args = HOT_PROGRAMS[name]()
+    report = AuditReport(name)
+    closed = jax.make_jaxpr(fn)(*args)
+    _scan_jaxpr(closed.jaxpr, report)
+    _scan_stablehlo(jax.jit(fn).lower(*args).as_text(), report)
+    return report
+
+
+def audit_all():
+    return [audit(name) for name in HOT_PROGRAMS]

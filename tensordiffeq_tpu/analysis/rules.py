@@ -1,0 +1,667 @@
+"""The tdqlint rule set — every invariant the last ten PRs learned the
+hard way, as one checked-in analysis pass (see docs/design.md for the
+PR-by-PR rationale).
+
+Rules are heuristics, not proofs: they under-report (no interprocedural
+analysis) and occasionally flag a deliberate site — that is what the
+``# tdq: allow[rule-id] reason`` escape hatch is for.  Every rule here is
+pure-AST (stdlib only); the jaxpr-level pass lives in
+:mod:`.jaxpr_audit`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .engine import (Context, Finding, ParsedModule, Rule, assigned_names,
+                     call_name, dotted_name)
+
+# --------------------------------------------------------------------- #
+# shared helpers
+# --------------------------------------------------------------------- #
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+_SCAN_NAMES = {"jax.lax.scan", "lax.scan"}
+
+
+def _is_jit_decorator(dec) -> bool:
+    """True for ``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+    ``@jax.jit(...)`` decorator nodes."""
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        cname = call_name(dec)
+        if cname in _JIT_NAMES:
+            return True
+        if cname in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _walk_in_order(node, skip_defs=True):
+    """Yield descendants in source order; optionally do not descend into
+    nested function/class definitions (they are their own scope)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if skip_defs and isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.ClassDef, ast.Lambda)):
+            continue
+        yield from _walk_in_order(child, skip_defs)
+
+
+def _function_defs(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# --------------------------------------------------------------------- #
+# 1 · host-sync-in-hot-path
+# --------------------------------------------------------------------- #
+
+class HostSyncRule(Rule):
+    """No host synchronisation inside the hot path.
+
+    PR 10 measured the cost of one stray sync: 163 ms of host stall per
+    redraw, 1.8 ms once removed.  Hot contexts are (a) jit-decorated or
+    ``jax.jit(fn)``-wrapped functions and their nested bodies, (b)
+    ``lax.scan`` body functions, (c) the fit chunk-loop drivers
+    (``fit_adam`` / ``lbfgs_minimize``) — where only *transfer-class*
+    syncs are flagged (``block_until_ready``, ``np.asarray``/``np.array``),
+    since scalar ``float()`` on already-transferred host data is free.
+    Deliberate fenced telemetry points carry an allow with the reason.
+    """
+
+    id = "host-sync-in-hot-path"
+    doc = "no .block_until_ready/np.asarray/float()/.item() in jit, " \
+          "scan bodies, or the fit chunk loops"
+
+    CHUNK_RUNNERS = {"fit_adam", "lbfgs_minimize"}
+    NP_TRANSFER = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                   "onp.asarray", "onp.array"}
+    TRACED_ONLY_ATTRS = {"item", "tolist"}
+
+    def _hot_defs(self, module: ParsedModule):
+        """(def_node, traced) pairs: traced=True for jit/scan contexts,
+        False for the chunk-loop drivers."""
+        jit_wrapped, scan_bodies = set(), set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname in _JIT_NAMES and node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Name):
+                        jit_wrapped.add(target.id)
+                    elif isinstance(target, ast.Attribute):
+                        jit_wrapped.add(target.attr)
+                elif cname in _SCAN_NAMES and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    scan_bodies.add(node.args[0].id)
+        for fn in _function_defs(module.tree):
+            if any(_is_jit_decorator(d) for d in fn.decorator_list) \
+                    or fn.name in jit_wrapped or fn.name in scan_bodies:
+                yield fn, True
+            elif fn.name in self.CHUNK_RUNNERS:
+                yield fn, False
+
+    def check(self, module: ParsedModule):
+        findings, seen = [], set()
+        for fn, traced in self._hot_defs(module):
+            ctx = "traced context" if traced else "fit chunk loop"
+            for node in ast.walk(fn):
+                hit = None
+                if isinstance(node, ast.Attribute):
+                    name = dotted_name(node)
+                    if node.attr == "block_until_ready":
+                        hit = ".block_until_ready() host fence"
+                    elif name in self.NP_TRANSFER:
+                        hit = f"{name} device->host transfer"
+                elif traced and isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name) \
+                            and node.func.id == "float" and node.args:
+                        hit = "float() forces a host sync on a traced value"
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in self.TRACED_ONLY_ATTRS:
+                        hit = f".{node.func.attr}() forces a host sync"
+                    elif call_name(node) == "jax.device_get":
+                        hit = "jax.device_get host transfer"
+                if hit and (node.lineno, hit) not in seen:
+                    seen.add((node.lineno, hit))
+                    findings.append(Finding(
+                        module.rel, node.lineno, self.id,
+                        f"{hit} inside {ctx} '{fn.name}' — hot-path "
+                        "host syncs serialize the device (PR 10: "
+                        "163ms->1.8ms per redraw)"))
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 2 · prng-key-reuse
+# --------------------------------------------------------------------- #
+
+class PrngKeyReuseRule(Rule):
+    """A PRNG key consumed twice without ``split``/``fold_in`` between
+    uses produces correlated draws — exactly the bug the device
+    resampler's ``fold_in(seed, epoch)`` discipline exists to prevent
+    (PR 10: a reused key across redraws silently re-selects the same
+    points and the adaptive win evaporates)."""
+
+    id = "prng-key-reuse"
+    doc = "no jax.random call re-consuming a key without split/fold_in"
+
+    NONCONSUMING = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                    "wrap_key_data", "clone"}
+
+    def _random_aliases(self, module: ParsedModule):
+        """(prefixes, bare) — dotted prefixes that mean jax.random, and
+        bare names imported from it."""
+        prefixes, bare = {"jax.random"}, {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.random":
+                        prefixes.add(a.asname or "jax.random")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "random":
+                            prefixes.add(a.asname or "random")
+                elif node.module == "jax.random":
+                    for a in node.names:
+                        bare[a.asname or a.name] = a.name
+        return prefixes, bare
+
+    def _consuming_call(self, node, prefixes, bare):
+        """The consumed key Name id, or None."""
+        if not isinstance(node, ast.Call) or not node.args:
+            return None
+        fn = None
+        name = call_name(node)
+        if name in bare:
+            fn = bare[name]
+        else:
+            head, _, tail = name.rpartition(".")
+            if head in prefixes:
+                fn = tail
+        if fn is None or fn in self.NONCONSUMING:
+            return None
+        first = node.args[0]
+        return first.id if isinstance(first, ast.Name) else None
+
+    def check(self, module: ParsedModule):
+        findings = []
+        prefixes, bare = self._random_aliases(module)
+        scopes = [module.tree] + list(_function_defs(module.tree))
+        for scope in scopes:
+            consumed = {}
+            for node in _walk_in_order(scope):
+                key = self._consuming_call(node, prefixes, bare)
+                if key is not None:
+                    if key in consumed:
+                        findings.append(Finding(
+                            module.rel, node.lineno, self.id,
+                            f"PRNG key '{key}' already consumed at line "
+                            f"{consumed[key]} — split or fold_in before "
+                            "reuse (reused keys correlate draws)"))
+                    else:
+                        consumed[key] = node.lineno
+                elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                       ast.AnnAssign, ast.For)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [getattr(node, "target", None)]
+                    for t in targets:
+                        if t is not None:
+                            for nm in assigned_names(t):
+                                consumed.pop(nm, None)
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 3 · dtype-discipline
+# --------------------------------------------------------------------- #
+
+class DtypeDisciplineRule(Rule):
+    """The bf16 fused paths (``ops/``, ``serving/engine.py``) must not
+    smuggle float64 in: one f64 leaf re-promotes whole XLA fusions and
+    silently halves the measured bf16 throughput (PR 9's end-to-end bf16
+    work).  Host-side f64 selection math is legal but must say so with an
+    allow."""
+
+    id = "dtype-discipline"
+    doc = "no float64 dtypes inside the bf16 fused paths " \
+          "(ops/, serving/engine.py)"
+
+    F64_ATTRS = {"np.float64", "numpy.float64", "jnp.float64",
+                 "jax.numpy.float64"}
+
+    def files(self, module: ParsedModule) -> bool:
+        return (module.rel.startswith("tensordiffeq_tpu/ops/")
+                or module.rel == "tensordiffeq_tpu/serving/engine.py")
+
+    def check(self, module: ParsedModule):
+        findings, seen = [], set()
+        for node in ast.walk(module.tree):
+            hit = None
+            if isinstance(node, ast.Attribute) \
+                    and dotted_name(node) in self.F64_ATTRS:
+                hit = dotted_name(node)
+            elif isinstance(node, ast.Constant) and node.value == "float64":
+                hit = '"float64"'
+            if hit and node.lineno not in seen:
+                seen.add(node.lineno)
+                findings.append(Finding(
+                    module.rel, node.lineno, self.id,
+                    f"{hit} inside a bf16 fused path — f64 leaves "
+                    "re-promote XLA fusions; keep device math <= f32 or "
+                    "allow with the host-side reason"))
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 4 · bare-raise-discipline
+# --------------------------------------------------------------------- #
+
+class RaiseDisciplineRule(Rule):
+    """Every raise uses a *typed* error, and every public error class
+    declares the ``trace_id`` attach hook (PR 7: structured errors carry
+    the trace id that resolves the failure's span tree in the run log —
+    a generic ``RuntimeError`` is invisible to that machinery)."""
+
+    id = "bare-raise-discipline"
+    doc = "no generic RuntimeError/Exception raises; public error " \
+          "classes declare trace_id"
+
+    GENERIC = {"Exception", "RuntimeError", "BaseException"}
+    BUILTIN_BASES = {"Exception", "BaseException", "RuntimeError",
+                     "ValueError", "TypeError", "KeyError", "OSError",
+                     "ArithmeticError", "LookupError", "IOError"}
+
+    def _error_classes(self, ctx: Context):
+        """{name: (module, node, has_trace_id, base_names)} over the
+        package, closed transitively over package bases."""
+        classes = {}
+        for module in ctx.modules:
+            if not module.rel.startswith("tensordiffeq_tpu/"):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {dotted_name(b).rpartition(".")[2]
+                         for b in node.bases}
+                # the hook is a class attr (`trace_id = None`), an
+                # annotated one, or an instance attr set in __init__
+                # (`self.trace_id = ...`, RequestTimeout-style)
+                has_tid = False
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Assign):
+                        for t in n.targets:
+                            if (isinstance(t, ast.Name)
+                                    and t.id == "trace_id") \
+                                or (isinstance(t, ast.Attribute)
+                                    and t.attr == "trace_id"
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                has_tid = True
+                    elif isinstance(n, ast.AnnAssign) \
+                            and isinstance(n.target, ast.Name) \
+                            and n.target.id == "trace_id":
+                        has_tid = True
+                classes[node.name] = (module, node, has_tid, bases)
+        # keep only exception classes: a base is a builtin exception or
+        # another collected error class (iterate to fixpoint)
+        errors = {}
+        changed = True
+        while changed:
+            changed = False
+            for name, (module, node, has_tid, bases) in classes.items():
+                if name in errors:
+                    continue
+                if bases & self.BUILTIN_BASES or bases & errors.keys():
+                    errors[name] = (module, node, has_tid, bases)
+                    changed = True
+
+        def carries_trace_id(name, seen=()):
+            if name not in errors or name in seen:
+                return False
+            module, node, has_tid, bases = errors[name]
+            return has_tid or any(carries_trace_id(b, seen + (name,))
+                                  for b in bases)
+
+        return errors, carries_trace_id
+
+    def check_project(self, ctx: Context):
+        findings = []
+        errors, carries_trace_id = self._error_classes(ctx)
+        for name, (module, node, _tid, _bases) in errors.items():
+            if name.startswith("_"):
+                continue  # private control-flow sentinels are exempt
+            if not carries_trace_id(name):
+                findings.append(Finding(
+                    module.rel, node.lineno, self.id,
+                    f"error class {name} does not declare the trace_id "
+                    "attach hook (add `trace_id = None` so attach_trace "
+                    "resolves failures to their span tree)"))
+        for module in ctx.modules:
+            if not module.rel.startswith("tensordiffeq_tpu/"):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                name = call_name(exc) if isinstance(exc, ast.Call) \
+                    else dotted_name(exc)
+                if name.rpartition(".")[2] in self.GENERIC:
+                    findings.append(Finding(
+                        module.rel, node.lineno, self.id,
+                        f"generic `raise {name}` — use a typed error "
+                        "from the structured set so callers and the "
+                        "trace layer can dispatch on it"))
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 5 · donated-buffer-reuse
+# --------------------------------------------------------------------- #
+
+class DonatedBufferReuseRule(Rule):
+    """An argument donated to a jitted program is deleted by the call —
+    touching it afterwards reads a dead buffer (an opaque XLA error at
+    best, silent garbage under some backends).  The chunk runners donate
+    their carried state (PR 5/9), so every call site must rebind the
+    donated names at the call."""
+
+    id = "donated-buffer-reuse"
+    doc = "no use of a variable after it was passed in a donated " \
+          "argument position"
+
+    def _donating(self, module: ParsedModule):
+        """{callable_name: donated positions} for jit-with-donate defs
+        and ``f = jax.jit(g, donate_argnums=...)`` assignments."""
+        out = {}
+
+        def positions(call):
+            for kw in call.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames") \
+                        and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in kw.value.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+                if kw.arg == "donate_argnums" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    return (kw.value.value,)
+            return ()
+
+        for fn in _function_defs(module.tree):
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_decorator(dec):
+                    pos = positions(dec)
+                    if pos:
+                        out[fn.name] = pos
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and call_name(node.value) in _JIT_NAMES:
+                pos = positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        for nm in assigned_names(t):
+                            out[nm] = pos
+        return out
+
+    @staticmethod
+    def _innermost_stmt(stmts, call):
+        """Index of the innermost statement whose subtree contains
+        ``call`` — a call inside an Assign inside a While must attribute
+        to the Assign, whose targets rebind the donated names.  The
+        source-order list puts the innermost container last."""
+        best = None
+        for i, stmt in enumerate(stmts):
+            if any(n is call for n in ast.walk(stmt)):
+                best = i
+        return best
+
+    def check(self, module: ParsedModule):
+        donating = self._donating(module)
+        if not donating:
+            return []
+        findings = []
+        for fn in _function_defs(module.tree):
+            stmts = [n for n in _walk_in_order(fn)
+                     if isinstance(n, ast.stmt)]
+            calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                     and call_name(n).rpartition(".")[2] in donating]
+            for call in calls:
+                i = self._innermost_stmt(stmts, call)
+                if i is None:
+                    continue
+                stmt = stmts[i]
+                cname = call_name(call).rpartition(".")[2]
+                rebound = set()
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        rebound |= assigned_names(t)
+                for p in donating[cname]:
+                    if p >= len(call.args) \
+                            or not isinstance(call.args[p], ast.Name):
+                        continue
+                    var = call.args[p].id
+                    if var in rebound:
+                        continue  # the donation idiom: rebind at the call
+                    for later in stmts[i + 1:]:
+                        loads = {n.id for n in ast.walk(later)
+                                 if isinstance(n, ast.Name)
+                                 and isinstance(n.ctx, ast.Load)}
+                        if var in loads:
+                            findings.append(Finding(
+                                module.rel, later.lineno, self.id,
+                                f"'{var}' was donated to {cname}() at "
+                                f"line {call.lineno} and is referenced "
+                                "afterwards — donated buffers are "
+                                "deleted by the call"))
+                            break
+                        later_binds = set()
+                        for n in ast.walk(later):
+                            if isinstance(n, (ast.Assign, ast.For)):
+                                tgts = n.targets if isinstance(
+                                    n, ast.Assign) else [n.target]
+                                for t in tgts:
+                                    later_binds |= assigned_names(t)
+                        if var in later_binds:
+                            break
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 6 · no-bare-print
+# --------------------------------------------------------------------- #
+
+class NoBarePrintRule(Rule):
+    """All package narration routes through ``telemetry.log_event``
+    (leveled, honours ``verbose``, mirrored into the JSONL sink) so quiet
+    runs are quiet and events are machine-readable (PR 4).  Only the
+    telemetry package itself, the progress bar, and the lint CLI module
+    (whose stdout IS its product — the engine/rules/audit modules stay
+    guarded) may print."""
+
+    id = "no-bare-print"
+    doc = "no bare print() outside telemetry/, training/progress.py, " \
+          "and the lint CLI module"
+
+    ALLOWED_PREFIXES = ("telemetry/",)
+    ALLOWED_FILES = ("training/progress.py", "analysis/__main__.py")
+
+    def files(self, module: ParsedModule) -> bool:
+        rel = module.pkg_rel()
+        if not rel:
+            return False
+        return not (rel.startswith(self.ALLOWED_PREFIXES)
+                    or rel in self.ALLOWED_FILES)
+
+    def check(self, module: ParsedModule):
+        return [Finding(module.rel, node.lineno, self.id,
+                        "bare print() — route narration through "
+                        "telemetry.log_event so quiet runs stay quiet "
+                        "and events reach the JSONL sink")
+                for node in ast.walk(module.tree)
+                if isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"]
+
+
+# --------------------------------------------------------------------- #
+# 7 · metrics-catalog
+# --------------------------------------------------------------------- #
+
+#: pre-PR-7 names wired into the bench payload contract; the catalog's
+#: legacy section documents them.  Frozen: new metrics must be dotted.
+LEGACY_METRICS = {"step_time_dispatch_s", "step_time_device_s",
+                  "step_time_data_s", "checkpoints", "divergences",
+                  "device_memory_peak_bytes"}
+
+_DOTTED = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_EMITTERS = {"counter", "gauge", "histogram"}
+_CATALOG_ROW = re.compile(r"^\s*\|\s*`([a-z0-9_.]+)`\s*\|")
+CATALOG_PATH = os.path.join("docs", "metrics.md")
+
+
+def emitted_metrics(ctx: Context) -> dict:
+    """``{name: [(rel, line), ...]}`` over the package + bench.py —
+    an emission is ``<expr>.counter("lit", ...)`` (/gauge/histogram) with
+    a string-literal first argument; ``IfExp`` first args count both
+    arms.  ``telemetry/registry.py`` (the instrument definitions) is
+    excluded."""
+    out = {}
+    for module in ctx.modules:
+        if module.rel == "tensordiffeq_tpu/telemetry/registry.py":
+            continue
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMITTERS and node.args):
+                continue
+            arg = node.args[0]
+            names = []
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.append(arg.value)
+            elif isinstance(arg, ast.IfExp):
+                for side in (arg.body, arg.orelse):
+                    if isinstance(side, ast.Constant) \
+                            and isinstance(side.value, str):
+                        names.append(side.value)
+            for name in names:
+                out.setdefault(name, []).append((module.rel, node.lineno))
+    return out
+
+
+def catalog_metrics(repo_root: str) -> dict:
+    """``{name: line}`` — the backticked first cell of each table row in
+    docs/metrics.md."""
+    names = {}
+    with open(os.path.join(repo_root, CATALOG_PATH)) as fh:
+        for lineno, line in enumerate(fh, 1):
+            m = _CATALOG_ROW.match(line)
+            if m:
+                names.setdefault(m.group(1), lineno)
+    return names
+
+
+class MetricsCatalogRule(Rule):
+    """docs/metrics.md is the operator contract for every emitted
+    instrument (PR 7): emissions missing from the catalog, stale catalog
+    rows, names violating the dotted ``subsystem.noun[.verb]`` scheme,
+    and legacy-allowlist entries whose emission is gone are all drift."""
+
+    id = "metrics-catalog"
+    doc = "every metric emission catalogued in docs/metrics.md, " \
+          "dotted naming, no stale rows"
+
+    def __init__(self, legacy=frozenset(LEGACY_METRICS)):
+        self.legacy = frozenset(legacy)
+
+    def check_project(self, ctx: Context):
+        findings = []
+        emitted = emitted_metrics(ctx)
+        catalog = catalog_metrics(ctx.repo_root)
+        for name, sites in sorted(emitted.items()):
+            if name not in catalog:
+                rel, line = sites[0]
+                findings.append(Finding(
+                    rel, line, self.id,
+                    f"metric '{name}' is emitted but missing from "
+                    f"{CATALOG_PATH} — document it or rename"))
+            if name not in self.legacy and not _DOTTED.match(name):
+                rel, line = sites[0]
+                findings.append(Finding(
+                    rel, line, self.id,
+                    f"metric '{name}' violates the dotted "
+                    "subsystem.noun[.verb] scheme (the legacy allowlist "
+                    "is frozen)"))
+        for name, line in sorted(catalog.items()):
+            if name not in emitted:
+                findings.append(Finding(
+                    CATALOG_PATH, line, self.id,
+                    f"catalog row '{name}' has no emission in the "
+                    "source — remove the row or restore the emission"))
+        for name in sorted(self.legacy - emitted.keys()):
+            findings.append(Finding(
+                CATALOG_PATH, catalog.get(name, 1), self.id,
+                f"legacy allowlist entry '{name}' is no longer emitted "
+                "— delete it from the allowlist and the catalog"))
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# 8 · pallas-interpret-coverage
+# --------------------------------------------------------------------- #
+
+class PallasCoverageRule(Rule):
+    """Every ``ops/`` module that launches a pallas kernel must be
+    exercised by an interpret-mode CPU test in tests/test_pallas.py —
+    interpret mode is the only pre-hardware signal tier-1 has (it
+    already missed three Mosaic-only failures once, PERF.md)."""
+
+    id = "pallas-interpret-coverage"
+    doc = "every ops/ pallas_call covered by an interpret-mode test " \
+          "in tests/test_pallas.py"
+
+    TEST_FILE = os.path.join("tests", "test_pallas.py")
+    _PALLAS_CALL = re.compile(r"\bpallas_call\s*\(")
+
+    def check_project(self, ctx: Context):
+        findings = []
+        test_path = os.path.join(ctx.repo_root, self.TEST_FILE)
+        try:
+            with open(test_path) as fh:
+                test_src = fh.read()
+        except OSError:
+            test_src = ""
+        has_interpret = "interpret=True" in test_src
+        for module in ctx.modules:
+            if not module.rel.startswith("tensordiffeq_tpu/ops/"):
+                continue
+            m = self._PALLAS_CALL.search(module.source)
+            if not m:
+                continue
+            mod = os.path.basename(module.rel)[:-3]
+            line = module.source[:m.start()].count("\n") + 1
+            if f"ops.{mod} import" not in test_src or not has_interpret:
+                findings.append(Finding(
+                    module.rel, line, self.id,
+                    f"ops module '{mod}' launches a pallas kernel but "
+                    f"registers no interpret-mode test in "
+                    f"{self.TEST_FILE} — interpret mode is the only "
+                    "pre-hardware signal tier-1 has"))
+        return findings
+
+
+#: registration order == report order for equal (file, line)
+ALL_RULES = (HostSyncRule(), PrngKeyReuseRule(), DtypeDisciplineRule(),
+             RaiseDisciplineRule(), DonatedBufferReuseRule(),
+             NoBarePrintRule(), MetricsCatalogRule(), PallasCoverageRule())
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -71,10 +71,14 @@ class TemplateMismatch(ValueError):
     Never absorbed by the previous-generation fallback: silently resuming
     an older run would be worse than the error."""
 
+    trace_id = None  # attach_trace hook (tdqlint bare-raise-discipline)
+
 
 class CheckpointCorrupted(RuntimeError):
     """No checkpoint generation under this path survived validation.
     ``failures`` maps each candidate directory to why it was rejected."""
+
+    trace_id = None
 
     def __init__(self, path: str, failures: dict):
         self.path = path

@@ -65,6 +65,8 @@ class TenantEvicted(RuntimeError):
     structured immediate failure instead of a deadline spin against an
     engine that no longer exists."""
 
+    trace_id = None
+
     def __init__(self, tenant: str):
         self.tenant = str(tenant)
         super().__init__(

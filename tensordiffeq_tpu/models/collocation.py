@@ -40,6 +40,30 @@ from ..utils import initialize_lambdas, tree_copy
 from .assembly import build_loss_fn
 
 
+class NotCompiledError(RuntimeError):
+    """A method that needs the compiled training graph ran before
+    ``compile(...)`` (or ``load_model(...)`` where a loaded network
+    suffices) — a usage-order error, typed so callers and the trace
+    layer can dispatch on it instead of string-matching RuntimeError."""
+
+    trace_id = None
+
+
+class AutotuneFailure(RuntimeError):
+    """``fused="autotune"`` had no surviving residual-engine candidate:
+    every engine failed to even compile.  Carries ``failures`` (engine
+    name -> exception) so the caller sees each candidate's reason."""
+
+    trace_id = None
+
+    def __init__(self, failures: dict):
+        self.failures = dict(failures)
+        super().__init__(
+            "autotune: every residual engine candidate failed: "
+            + "; ".join(f"{k}: {type(e).__name__}: {e}"
+                        for k, e in failures.items()))
+
+
 class _DeviceResampleHook:
     """``fit_adam``-facing adapter around
     :class:`~tensordiffeq_tpu.ops.resampling.DeviceResampler`: owns epoch
@@ -474,10 +498,7 @@ class CollocationSolverND:
                 # (e.g. Mosaic lowering failure) is excluded, not fatal
                 failures[name] = e
         if not timings:
-            raise RuntimeError(
-                "autotune: every residual engine candidate failed: "
-                + "; ".join(f"{k}: {type(e).__name__}: {e}"
-                            for k, e in failures.items()))
+            raise AutotuneFailure(failures)
         best = min(timings, key=timings.get)
         shown = ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in timings.items())
         for k, e in failures.items():
@@ -1041,7 +1062,7 @@ class CollocationSolverND:
         state is flushed through the ``checkpoint_dir`` hook and
         :class:`~tensordiffeq_tpu.resilience.Preempted` is raised."""
         if not self._compiled:
-            raise RuntimeError("Call compile(...) before fit(...)")
+            raise NotCompiledError("Call compile(...) before fit(...)")
         if profile_dir is not None:
             from ..profiling import trace
             with trace(profile_dir):
@@ -1530,8 +1551,9 @@ class CollocationSolverND:
         X_star = jnp.asarray(X_star, jnp.float32)
         if not self._compiled:
             if not getattr(self, "_loaded", False):
-                raise RuntimeError("Call compile(...) or load_model(...) "
-                                   "before predict(...)")
+                raise NotCompiledError(
+                    "Call compile(...) or load_model(...) before "
+                    "predict(...)")
             # loaded-but-uncompiled: the solution net exists, the PDE
             # residual does not (no f_model yet) — reference load_model
             # semantics (a bare Keras model, models.py:318-319)
@@ -1556,8 +1578,9 @@ class CollocationSolverND:
         through ``surrogate.engine()``.  ``best_model=True`` exports the
         best iterate, as in :meth:`predict`."""
         if not self._compiled and not getattr(self, "_loaded", False):
-            raise RuntimeError("Call compile(...) or load_model(...) "
-                               "before export_surrogate()")
+            raise NotCompiledError(
+                "Call compile(...) or load_model(...) before "
+                "export_surrogate()")
         from ..serving import Surrogate
         return Surrogate.from_solver(self, best_model=best_model)
 
@@ -1621,7 +1644,8 @@ class CollocationSolverND:
         resumes sharded, no host-resident λ, sampler/λ/optimizer state
         intact."""
         if not self._compiled:
-            raise RuntimeError("Call compile(...) before restore_checkpoint")
+            raise NotCompiledError(
+                "Call compile(...) before restore_checkpoint")
         from ..checkpoint import restore_checkpoint
         # peek at meta to know whether optimizer moments were saved (via
         # resolve_checkpoint_dir so the killed-mid-swap .old fallback the

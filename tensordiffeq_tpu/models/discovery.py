@@ -47,6 +47,7 @@ import optax
 
 from ..networks import neural_net
 from ..ops.derivatives import make_ufn, vmap_residual
+from .collocation import NotCompiledError
 from ..ops.losses import MSE, default_g, g_MSE
 from ..output import print_screen
 from ..training.fit import make_batches
@@ -458,7 +459,8 @@ class DiscoveryModel:
         model; under ``dist=True`` the SA col_weights are re-placed on the
         mesh after loading."""
         if not hasattr(self, "trainables"):
-            raise RuntimeError("Call compile(...) before restore_checkpoint")
+            raise NotCompiledError(
+                "Call compile(...) before restore_checkpoint")
         from ..checkpoint import restore_checkpoint
         template = {"trainables": self.trainables,
                     "opt_state": self.opt_state}
@@ -486,7 +488,8 @@ class DiscoveryModel:
         ``f_model(u, var, *coords)`` — evaluates the learned equation's
         residual without any training state."""
         if not hasattr(self, "trainables"):
-            raise RuntimeError("Call compile(...) before export_surrogate()")
+            raise NotCompiledError(
+                "Call compile(...) before export_surrogate()")
         from ..serving import Surrogate
         return Surrogate.from_discovery(self)
 

@@ -45,6 +45,17 @@ def _build() -> None:
             os.remove(tmp)
 
 
+class NativeUnavailable(RuntimeError):
+    """The native C++ kernel could not be loaded (no compiler, build
+    error, or opt-out) and the caller did not fall back — typed so
+    callers can catch exactly this and choose the pure-numpy path."""
+
+    trace_id = None
+
+    def __init__(self):
+        super().__init__("native library unavailable")
+
+
 def load():
     """Return the loaded ctypes library, building it if needed, or ``None``
     when native support is unavailable (no compiler, build error, opt-out)."""
@@ -88,7 +99,7 @@ def phi_p(X: np.ndarray, p: float = 10.0) -> float:
     """PhiP space-filling criterion via the native kernel."""
     lib = load()
     if lib is None:
-        raise RuntimeError("native library unavailable")
+        raise NativeUnavailable()
     X = np.ascontiguousarray(X, dtype=np.float64)
     return lib.tdq_phi_p(
         X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
@@ -105,7 +116,7 @@ def ese_optimize(X: np.ndarray, p: float = 10.0,
     """
     lib = load()
     if lib is None:
-        raise RuntimeError("native library unavailable")
+        raise NativeUnavailable()
     out = np.ascontiguousarray(X, dtype=np.float64).copy()
     lib.tdq_ese_optimize(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),

@@ -64,6 +64,7 @@ def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
     ``uniform_frac=1`` degenerates to a uniform redraw; ``temp`` sharpens
     (>1) or flattens (<1) the residual concentration."""
     rng = rng or np.random.default_rng(0)
+    # tdq: allow[dtype-discipline] host-side selection math (reference path): f64 keeps Gumbel keys exact, never enters a device program
     s = np.abs(np.asarray(scores, np.float64)).ravel()
     if n_keep >= s.size:
         return np.arange(s.size)
@@ -87,6 +88,7 @@ def importance_select(scores: np.ndarray, n_keep: int, temp: float = 1.0,
     # n_keep exceeds the nonzero count.  The tiny floor keeps every row
     # reachable through its Gumbel noise while leaving nonzero
     # probabilities untouched at float64 scale.
+    # tdq: allow[dtype-discipline] host-side f64 tiny-floor keeps zero-residual rows reachable without log(0)
     keys = np.log(np.maximum(p, np.finfo(np.float64).tiny)) + gumbel
     return np.argpartition(-keys, n_keep)[:n_keep]
 
@@ -96,6 +98,7 @@ def _row_scores(values) -> np.ndarray:
     output columns.  The ONE reduction both the single-host and multi-host
     scoring paths share — they must stay bitwise-identical for a resampled
     run to reproduce across topologies (test_multihost asserts this)."""
+    # tdq: allow[dtype-discipline] host-side score accumulation in f64 so summed |f| never saturates
     a = np.abs(np.asarray(values, np.float64))
     return a.reshape(a.shape[0], -1).sum(axis=1)
 
@@ -125,11 +128,14 @@ def _allgather_by_row(local: dict, n: int) -> np.ndarray:
 
     rows = np.concatenate([np.arange(s, s + v.shape[0])
                            for s, v in sorted(local.items())])
+    # tdq: allow[dtype-discipline] the multihost row-lane packing CONTRACT: one f64 allgather lane, exact to 2^53
     vals = np.concatenate([np.asarray(v, np.float64).reshape(v.shape[0], -1)
                            for _, v in sorted(local.items())])
+    # tdq: allow[dtype-discipline] row indices ride the same f64 lane (exact integers up to 2^53)
     packed = np.concatenate([rows[:, None].astype(np.float64), vals], axis=1)
     packed_all = np.asarray(multihost_utils.process_allgather(packed))
     packed_all = packed_all.reshape(-1, packed.shape[1])
+    # tdq: allow[dtype-discipline] host-side scatter target of the f64 allgather lane
     out = np.zeros((n, vals.shape[1]), np.float64)
     out[packed_all[:, 0].astype(np.int64)] = packed_all[:, 1:]
     return out
@@ -233,6 +239,7 @@ class DeviceResampler:
                  *, pool_factor: int = 4, temp: float = 1.0,
                  uniform_frac: float = 0.1, seed: int = 0, like=None):
         self.residual_fn = residual_fn
+        # tdq: allow[dtype-discipline] domain limits held in f64 on the HOST; the jitted pool draw casts per-dim bounds to f32 scalars
         self.xlimits = np.asarray(xlimits, np.float64)
         self.n_f = int(n_f)
         self.temp = float(temp)
@@ -347,6 +354,7 @@ def gather_rows_multihost(X_global) -> np.ndarray:
     local: dict[int, np.ndarray] = {}
     for shard in X_global.addressable_shards:
         start = shard.index[0].start or 0
+        # tdq: allow[dtype-discipline] feeds the f64 row-lane packing contract of _allgather_by_row
         local[start] = np.asarray(shard.data, np.float64)
     out = _allgather_by_row(local, n)
     return out.reshape((n,) + tuple(X_global.shape[1:]))

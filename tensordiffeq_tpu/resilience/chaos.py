@@ -98,6 +98,8 @@ class ChaosFault(RuntimeError):
     """Base class of every injected fault (so supervisors can tell an
     injected fault from an organic one when both are possible)."""
 
+    trace_id = None  # attach_trace hook, inherited by every chaos fault
+
 
 class ChaosServingError(ChaosFault):
     """Injected transient serving-op failure (retryable)."""

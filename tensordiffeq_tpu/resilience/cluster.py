@@ -96,6 +96,8 @@ def free_port() -> int:
 class HostLost(RuntimeError):
     """The cluster exhausted its relaunch budget (or lost every host)."""
 
+    trace_id = None  # attach_trace hook (tdqlint bare-raise-discipline)
+
 
 @dataclass
 class _Worker:

@@ -45,6 +45,8 @@ class Preempted(RuntimeError):
     ``phase``, ``epoch`` (absolute), and ``flush_s`` (final checkpoint
     wall, None when no checkpoint hook was configured)."""
 
+    trace_id = None
+
     def __init__(self, phase: str, epoch: int,
                  flush_s: Optional[float] = None):
         self.phase = phase

@@ -55,6 +55,8 @@ class EngineDegraded(RuntimeError):
     """Every bucket a query could route to is quarantined for this query
     kind — the engine cannot serve it (other kinds keep serving)."""
 
+    trace_id = None
+
     def __init__(self, kind, buckets):
         self.kind = kind
         self.buckets = tuple(buckets)

@@ -514,8 +514,10 @@ def fit_adam(loss_fn: Callable,
             # soon as the scan is dispatched; the block measures what the
             # device is still busy with
             t_disp = time.perf_counter() - t_chunk0
+            # tdq: allow[host-sync-in-hot-path] THE fenced telemetry point: one deliberate fence per chunk prices dispatch vs device wait
             jax.block_until_ready(comps)
             t_dev = time.perf_counter() - t_chunk0 - t_disp
+        # tdq: allow[host-sync-in-hot-path] per-chunk loss-history transfer: comps are already computed; one pull per chunk, not per step
         comps = jax.tree_util.tree_map(np.asarray, comps)
         # record one entry per epoch (last batch of each epoch)
         for e in range(n // n_batches):
@@ -667,6 +669,7 @@ def fit_adam(loss_fn: Callable,
                                          else None))
     if pbar is not None:
         pbar.close()
+    # tdq: allow[host-sync-in-hot-path] phase-final fence: the wall clock must include the last chunk's device time
     jax.block_until_ready(trainables)
     result.wall_time["adam"] = time.time() - t0
 

@@ -185,11 +185,14 @@ def lbfgs_minimize(fun: Callable, x0, maxiter: int = 1000,
             x, state, best, jnp.asarray(done), args, n)
         if telemetry is not None:
             t_disp = time.perf_counter() - t_chunk0
+            # tdq: allow[host-sync-in-hot-path] fenced telemetry point: the deliberate per-chunk dispatch/device split fence
             jax.block_until_ready(values)
             telemetry.on_step_time(
                 "l-bfgs", n, t_disp,
                 time.perf_counter() - t_chunk0 - t_disp)
+        # tdq: allow[host-sync-in-hot-path] per-chunk history transfer: the stop tests need host values once per chunk
         values = np.asarray(values)
+        # tdq: allow[host-sync-in-hot-path] rides the same per-chunk transfer as values
         gnorms = np.asarray(gnorms)
         history.extend(float(v) for v in values)
         prev_done = done

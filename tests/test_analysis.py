@@ -1,0 +1,495 @@
+"""tdqlint fixture tests: one minimal tripping fixture + one passing
+fixture per rule, plus the engine's suppression semantics.
+
+Pure-AST by construction: the analysis package is loaded STANDALONE from
+its directory (no ``tensordiffeq_tpu`` parent import, hence no jax/flax/
+optax import) so this module costs milliseconds of wall, not a backend
+init — the tier-1 wall-budget discipline the ROADMAP note demands.  A
+self-lint test pins that property: the analysis package's top-level
+imports must stay stdlib-only.
+"""
+
+import ast
+import importlib.util
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "tensordiffeq_tpu", "analysis")
+
+
+def _load_standalone():
+    """Load tensordiffeq_tpu/analysis as a top-level package so the
+    parent package __init__ (which imports jax) never runs."""
+    name = "_tdqa_standalone"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+A = _load_standalone()
+engine = sys.modules["_tdqa_standalone.engine"]
+rules = sys.modules["_tdqa_standalone.rules"]
+
+
+def lint(tmp_path, sources, rule, extra=None):
+    """Write a miniature repo into ``tmp_path`` and run ``rule`` on it
+    via the DEFAULT walk (sources must live under tensordiffeq_tpu/ in
+    the fake repo) — so project-scoped rules run too, exactly as they do
+    on the real tree.
+
+    ``sources``: {repo-relative path: python source}.  ``extra``:
+    {repo-relative path: raw text} for non-linted files (docs, tests).
+    Returns the findings list.
+    """
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, text in (extra or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    findings, _ = engine.run_rules([rule], repo_root=str(tmp_path))
+    return findings
+
+
+def rule_findings(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# --------------------------------------------------------------------- #
+# engine: suppression semantics
+# --------------------------------------------------------------------- #
+
+TRIP_PRINT = {"tensordiffeq_tpu/mod.py": "print('hello')\n"}
+
+
+def test_suppression_with_reason_absorbs_finding(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        "print('x')  # tdq: allow[no-bare-print] CLI surface, stdout is the product\n",
+    }, rules.NoBarePrintRule())
+    assert findings == []
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        "# tdq: allow[no-bare-print] demo reason\n"
+        "print('x')\n",
+    }, rules.NoBarePrintRule())
+    assert findings == []
+
+
+def test_suppression_without_reason_fails(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        "print('x')  # tdq: allow[no-bare-print]\n",
+    }, rules.NoBarePrintRule())
+    assert [f.rule for f in findings] == [engine.META_MISSING_REASON]
+
+
+def test_unused_suppression_fails(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        "x = 1  # tdq: allow[no-bare-print] nothing here trips\n",
+    }, rules.NoBarePrintRule())
+    assert [f.rule for f in findings] == [engine.META_UNUSED]
+
+
+def test_unknown_suppression_rule_id_flagged(tmp_path):
+    """A typo'd allow must not sit inert forever: with the full registry
+    handed to the engine, an allow naming no known rule is a finding."""
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1  # tdq: allow[host-sync-in-hotpath] typo'd id\n")
+    findings, _ = engine.run_rules(
+        [rules.NoBarePrintRule()], repo_root=str(tmp_path),
+        files=[str(p)], known_rules=frozenset(rules.RULES_BY_ID))
+    assert [f.rule for f in findings] == [engine.META_UNKNOWN_RULE]
+
+
+def test_project_rules_skipped_on_explicit_file_subset(tmp_path):
+    """An explicit-files run must not judge cross-file properties: the
+    metrics-catalog rule against one file would report every catalog row
+    as stale."""
+    p = tmp_path / "tensordiffeq_tpu" / "mod.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("x = 1\n")
+    doc = tmp_path / "docs" / "metrics.md"
+    doc.parent.mkdir(parents=True)
+    doc.write_text("| `some.metric` | emitted elsewhere |\n")
+    findings, _ = engine.run_rules(
+        [rules.MetricsCatalogRule(legacy=())], repo_root=str(tmp_path),
+        files=[str(p)])
+    assert findings == []
+
+
+def test_suppression_for_unselected_rule_is_not_judged(tmp_path):
+    # a dtype allow must not read as stale when only no-bare-print runs
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        "x = 1  # tdq: allow[dtype-discipline] other rule's allow\n",
+    }, rules.NoBarePrintRule())
+    assert findings == []
+
+
+def test_finding_format_is_file_line_rule_message(tmp_path):
+    findings = lint(tmp_path, TRIP_PRINT, rules.NoBarePrintRule())
+    assert len(findings) == 1
+    line = findings[0].format()
+    assert line.startswith("tensordiffeq_tpu/mod.py:1 no-bare-print ")
+
+
+# --------------------------------------------------------------------- #
+# 1 · host-sync-in-hot-path
+# --------------------------------------------------------------------- #
+
+def test_host_sync_trips_inside_jit(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+    """}, rules.HostSyncRule())
+    assert [f.rule for f in findings] == ["host-sync-in-hot-path"]
+
+
+def test_host_sync_trips_in_scan_body_and_jit_wrapped(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+        import numpy as np
+
+        def body(carry, x):
+            return np.asarray(carry), None
+
+        def outer(xs):
+            return jax.lax.scan(body, 0.0, xs)
+
+        def _impl(x):
+            return x.item()
+
+        wrapped = jax.jit(_impl)
+    """}, rules.HostSyncRule())
+    assert sorted(f.line for f in findings) == [6, 12]
+
+
+def test_host_sync_chunk_runner_flags_transfers_not_float(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+        import numpy as np
+
+        def fit_adam(comps):
+            jax.block_until_ready(comps)      # transfer-class: flagged
+            comps = np.asarray(comps)         # transfer-class: flagged
+            return float(comps[0])            # host scalar: NOT flagged
+    """}, rules.HostSyncRule())
+    assert sorted(f.line for f in findings) == [6, 7]
+
+
+def test_host_sync_passes_clean_jit(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.sum(x) * 2.0
+
+        def host_helper(x):
+            return float(x)   # not a hot context
+    """}, rules.HostSyncRule())
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# 2 · prng-key-reuse
+# --------------------------------------------------------------------- #
+
+def test_prng_key_reuse_trips(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+
+        def f(key):
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+    """}, rules.PrngKeyReuseRule())
+    assert [f.rule for f in findings] == ["prng-key-reuse"]
+    assert findings[0].line == 6
+
+
+def test_prng_key_reuse_passes_with_split_and_rebind(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.uniform(k1, (3,))
+            b = jax.random.normal(k2, (3,))
+            key = jax.random.fold_in(key, 7)
+            c = jax.random.gumbel(key, (3,))
+            return a + b + c
+    """}, rules.PrngKeyReuseRule())
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# 3 · dtype-discipline
+# --------------------------------------------------------------------- #
+
+def test_dtype_discipline_trips_in_ops(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/ops/mod.py": """
+        import numpy as np
+        X = np.zeros((3,), np.float64)
+    """}, rules.DtypeDisciplineRule())
+    assert [f.rule for f in findings] == ["dtype-discipline"]
+
+
+def test_dtype_discipline_scoped_to_fused_paths(tmp_path):
+    # the same source outside ops//serving/engine.py is out of scope,
+    # and f32 inside ops/ is clean
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/models/mod.py":
+        "import numpy as np\nX = np.zeros((3,), np.float64)\n",
+        "tensordiffeq_tpu/ops/clean.py":
+        "import numpy as np\nX = np.zeros((3,), np.float32)\n",
+    }, rules.DtypeDisciplineRule())
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# 4 · bare-raise-discipline
+# --------------------------------------------------------------------- #
+
+def test_raise_discipline_trips_generic_and_missing_trace_id(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        class FooError(RuntimeError):
+            pass
+
+        def f():
+            raise RuntimeError("boom")
+    """}, rules.RaiseDisciplineRule())
+    msgs = sorted((f.line, f.message.split(" ")[0]) for f in findings)
+    assert len(findings) == 2
+    assert findings[0].rule == "bare-raise-discipline"
+    assert {2, 6} == {f.line for f in findings}
+    assert msgs  # class finding at 2, raise finding at 6
+
+
+def test_raise_discipline_passes_typed_with_trace_id(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        class FooError(RuntimeError):
+            trace_id = None
+
+        class SubError(FooError):
+            pass
+
+        class _Internal(Exception):
+            pass
+
+        def f(flag):
+            if flag:
+                raise FooError("typed")
+            raise ValueError("specific builtins stay legal")
+    """}, rules.RaiseDisciplineRule())
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# 5 · donated-buffer-reuse
+# --------------------------------------------------------------------- #
+
+def test_donated_buffer_reuse_trips(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(state, x):
+            return state
+
+        def caller(state, x):
+            out = run(state, x)
+            return state
+    """}, rules.DonatedBufferReuseRule())
+    assert [f.rule for f in findings] == ["donated-buffer-reuse"]
+    assert findings[0].line == 11
+
+
+def test_donated_buffer_reuse_passes_rebind_idiom(tmp_path):
+    findings = lint(tmp_path, {"tensordiffeq_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run(state, opt, x):
+            return state, opt
+
+        def caller(state, opt, x):
+            state, opt = run(state, opt, x)
+            return state, opt
+    """}, rules.DonatedBufferReuseRule())
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# 6 · no-bare-print
+# --------------------------------------------------------------------- #
+
+def test_no_bare_print_trips(tmp_path):
+    findings = lint(tmp_path, TRIP_PRINT, rules.NoBarePrintRule())
+    assert [f.rule for f in findings] == ["no-bare-print"]
+
+
+def test_no_bare_print_passes_in_telemetry_and_analysis(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/telemetry/runlog.py": "print('narration path')\n",
+        "tensordiffeq_tpu/analysis/__main__.py": "print('lint output')\n",
+        "tensordiffeq_tpu/training/progress.py": "print('bar')\n",
+    }, rules.NoBarePrintRule())
+    assert findings == []
+
+
+def test_no_bare_print_guards_the_engine_itself(tmp_path):
+    """Only the CLI module may print — a stray debug print in the rule
+    engine is a finding like anywhere else."""
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/analysis/rules.py": "print('debug')\n",
+    }, rules.NoBarePrintRule())
+    assert [f.rule for f in findings] == ["no-bare-print"]
+
+
+# --------------------------------------------------------------------- #
+# 7 · metrics-catalog
+# --------------------------------------------------------------------- #
+
+_CATALOG = """
+    # metrics
+    | name | meaning |
+    |---|---|
+    | `serving.good` | fine |
+    | `stale.row` | emitted by nothing |
+"""
+
+
+def test_metrics_catalog_trips_on_drift(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py": """
+            reg.counter("serving.good").inc()
+            reg.counter("not.in.catalog").inc()
+            reg.gauge("badname").set(1)
+        """,
+    }, rules.MetricsCatalogRule(legacy=()),
+        extra={"docs/metrics.md": _CATALOG})
+    msgs = " | ".join(f.message for f in findings)
+    assert "not.in.catalog" in msgs          # emitted, uncatalogued
+    assert "stale.row" in msgs               # catalogued, unemitted
+    assert "badname" in msgs                 # naming scheme
+    # badname is both uncatalogued and non-dotted: 2 findings for it
+    assert len(findings) == 4
+
+
+def test_metrics_catalog_passes_in_sync(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py":
+        'reg.counter("serving.good").inc()\n'
+        'reg.histogram("stale.row").observe(2)\n',
+    }, rules.MetricsCatalogRule(legacy=()),
+        extra={"docs/metrics.md": _CATALOG})
+    assert findings == []
+
+
+def test_metrics_catalog_legacy_must_stay_emitted(tmp_path):
+    findings = lint(tmp_path, {
+        "tensordiffeq_tpu/mod.py": 'reg.counter("serving.good").inc()\n',
+    }, rules.MetricsCatalogRule(legacy=("checkpoints",)),
+        extra={"docs/metrics.md": _CATALOG + "    | `checkpoints` | x |\n"})
+    gone = [f for f in findings if "no longer emitted" in f.message]
+    assert len(gone) == 1 and "checkpoints" in gone[0].message
+
+
+# --------------------------------------------------------------------- #
+# 8 · pallas-interpret-coverage
+# --------------------------------------------------------------------- #
+
+_PALLAS_MOD = """
+    from jax.experimental import pallas as pl
+
+    def build(interpret=False):
+        return pl.pallas_call(lambda ref: None, out_shape=None,
+                              interpret=interpret)
+"""
+
+
+def test_pallas_coverage_trips_without_test(tmp_path):
+    findings = lint(tmp_path,
+                    {"tensordiffeq_tpu/ops/pallas_demo.py": _PALLAS_MOD},
+                    rules.PallasCoverageRule(),
+                    extra={"tests/test_pallas.py": "# nothing here\n"})
+    assert [f.rule for f in findings] == ["pallas-interpret-coverage"]
+
+
+def test_pallas_coverage_passes_with_interpret_test(tmp_path):
+    findings = lint(tmp_path,
+                    {"tensordiffeq_tpu/ops/pallas_demo.py": _PALLAS_MOD},
+                    rules.PallasCoverageRule(),
+                    extra={"tests/test_pallas.py": """
+                        from tensordiffeq_tpu.ops.pallas_demo import build
+
+                        def test_demo():
+                            build(interpret=True)
+                    """})
+    assert findings == []
+
+
+# --------------------------------------------------------------------- #
+# the engine's own hygiene
+# --------------------------------------------------------------------- #
+
+def test_rule_registry_shape():
+    assert len(rules.ALL_RULES) == 8
+    ids = [r.id for r in rules.ALL_RULES]
+    assert len(set(ids)) == 8
+    assert all(r.doc for r in rules.ALL_RULES)
+    assert set(rules.RULES_BY_ID) == set(ids)
+
+
+def test_unknown_rule_id_raises():
+    try:
+        A.run_analysis(select=["no-such-rule"])
+    except ValueError as e:
+        assert "no-such-rule" in str(e)
+    else:
+        raise AssertionError("unknown rule id accepted")
+
+
+def test_analysis_package_is_stdlib_only_at_import():
+    """The wall-budget contract: importing the AST engine must never pull
+    jax (or the package's own heavy deps).  jaxpr_audit may NAME jax only
+    inside function bodies (lazy import)."""
+    heavy = {"jax", "jaxlib", "numpy", "flax", "optax", "scipy",
+             "tensordiffeq_tpu"}
+    for fname in sorted(os.listdir(ANALYSIS_DIR)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(ANALYSIS_DIR, fname)) as fh:
+            tree = ast.parse(fh.read(), filename=fname)
+        for node in tree.body:  # TOP-LEVEL statements only
+            if isinstance(node, ast.Import):
+                roots = {a.name.split(".")[0] for a in node.names}
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                roots = {(node.module or "").split(".")[0]}
+            else:
+                continue
+            assert not roots & heavy, (
+                f"{fname} imports {roots & heavy} at module level — the "
+                "analysis package must stay stdlib-only at import time")

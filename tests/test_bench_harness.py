@@ -608,6 +608,18 @@ def test_elastic_json_contract(tmp_path):
     assert payload["chaos"] == "host_loss_at=10"
 
 
+def test_lint_gate_contract():
+    """`bench.py --lint` is the CI gate over the SOURCE (tdqlint, PR 12):
+    one machine-readable verdict line, exit 0 clean / 3 on findings —
+    same exit-0-always exemption as --slo.  In-process (the subprocess
+    contract is pinned by tests/test_lint_clean.py) to keep tier-1 wall
+    small."""
+    bench = _load_bench()
+    v = bench.lint_verdict()
+    assert v["ok"] is True and v["value"] == 0 and v["findings"] == []
+    assert v["unit"] == "findings" and v["files_scanned"] > 50
+
+
 def test_slo_gate_contract(tmp_path):
     """`bench.py --slo TARGET` is the CI gate over captured evidence:
     one machine-readable verdict line, exit 0 when every objective is in

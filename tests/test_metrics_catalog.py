@@ -1,116 +1,55 @@
 """Metrics-catalog drift guard: every registry emission in the source is
-documented in ``docs/metrics.md``, every catalog row still exists, and new
-metric names follow the dotted ``subsystem.noun[.verb]`` scheme.
+documented in ``docs/metrics.md``, every catalog row still exists, and
+new metric names follow the dotted ``subsystem.noun[.verb]`` scheme.
 
-AST-based (like ``test_no_bare_print.py``) so comments/docstrings naming a
-metric don't false-positive: an emission is a call ``<expr>.counter("lit",
-...)`` / ``.gauge(...)`` / ``.histogram(...)`` whose first argument is a
-string literal.  ``telemetry/registry.py`` (the instrument definitions)
-is excluded; ``bench.py`` is included — it emits into the shared registry
-and its names ride every payload's telemetry block.
+Since PR 12 this is a thin wrapper over the tdqlint engine's
+``metrics-catalog`` rule (one walker, one suppression syntax — the
+copy-pasted AST scan moved to ``tensordiffeq_tpu/analysis/rules.py``);
+the test names are kept so CI history stays comparable.  Each test
+filters the rule's findings by defect class, so a failure still points
+at exactly the drift it always did.
 """
 
-import ast
-import os
-import re
+import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "tensordiffeq_tpu")
-CATALOG = os.path.join(REPO, "docs", "metrics.md")
-
-EMITTERS = {"counter", "gauge", "histogram"}
-
-# pre-PR-7 names wired into the bench payload contract and existing
-# tests; the catalog's legacy section documents them.  Frozen: new
-# metrics must be dotted.
-LEGACY = {"step_time_dispatch_s", "step_time_device_s", "step_time_data_s",
-          "checkpoints", "divergences", "device_memory_peak_bytes"}
-
-DOTTED = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+from tensordiffeq_tpu.analysis import run_analysis
 
 
-def _emissions(path):
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in EMITTERS and node.args):
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            out.append((arg.value, node.lineno))
-        elif isinstance(arg, ast.IfExp):
-            # `counter("a" if cond else "b", ...)` — both arms count
-            for side in (arg.body, arg.orelse):
-                if isinstance(side, ast.Constant) \
-                        and isinstance(side.value, str):
-                    out.append((side.value, node.lineno))
-    return out
+@pytest.fixture(scope="module")
+def catalog_findings():
+    findings, _ = run_analysis(select=["metrics-catalog"])
+    return findings
 
 
-def emitted_metrics():
-    """``{name: [site, ...]}`` over the package + bench.py."""
-    files = [os.path.join(REPO, "bench.py")]
-    for root, _dirs, names in os.walk(PKG):
-        for name in names:
-            if name.endswith(".py"):
-                files.append(os.path.join(root, name))
-    out = {}
-    for path in files:
-        rel = os.path.relpath(path, REPO)
-        if rel == os.path.join("tensordiffeq_tpu", "telemetry",
-                               "registry.py"):
-            continue  # the instrument definitions, not emissions
-        for name, lineno in _emissions(path):
-            out.setdefault(name, []).append(f"{rel}:{lineno}")
-    return out
+def _pick(findings, needle):
+    return [f.format() for f in findings if needle in f.message]
 
 
-def catalog_metrics():
-    """Metric names in docs/metrics.md: the backticked FIRST cell of each
-    table row (the meaning column is prose and may name functions)."""
-    names = set()
-    row = re.compile(r"^\s*\|\s*`([a-z0-9_.]+)`\s*\|")
-    with open(CATALOG) as fh:
-        for line in fh:
-            m = row.match(line)
-            if m:
-                names.add(m.group(1))
-    return names
-
-
-def test_every_emission_is_cataloged():
-    cat = catalog_metrics()
-    missing = {name: sites for name, sites in emitted_metrics().items()
-               if name not in cat}
+def test_every_emission_is_cataloged(catalog_findings):
+    missing = _pick(catalog_findings, "missing from")
     assert not missing, (
         "metrics emitted but missing from docs/metrics.md (document them "
         f"or rename): {missing}")
 
 
-def test_catalog_has_no_stale_rows():
-    emitted = set(emitted_metrics())
-    stale = sorted(catalog_metrics() - emitted)
+def test_catalog_has_no_stale_rows(catalog_findings):
+    stale = _pick(catalog_findings, "has no emission")
     assert not stale, (
         "docs/metrics.md lists metrics no source emits (remove the rows "
         f"or restore the emission): {stale}")
 
 
-def test_naming_scheme_dotted_subsystem_noun():
-    bad = {name: sites for name, sites in emitted_metrics().items()
-           if name not in LEGACY and not DOTTED.match(name)}
+def test_naming_scheme_dotted_subsystem_noun(catalog_findings):
+    bad = _pick(catalog_findings, "violates the dotted")
     assert not bad, (
         "metric names must follow the dotted subsystem.noun[.verb] "
         "scheme (lowercase, >= 2 dot-separated segments); the legacy "
         f"allowlist is frozen: {bad}")
 
 
-def test_legacy_allowlist_is_tight():
+def test_legacy_allowlist_is_tight(catalog_findings):
     """Every grandfathered name is still actually emitted — a legacy
-    entry whose emission is gone must be deleted here AND in the
+    entry whose emission is gone must be deleted in the rule AND the
     catalog, not kept as a loophole."""
-    emitted = set(emitted_metrics())
-    gone = sorted(LEGACY - emitted)
+    gone = _pick(catalog_findings, "no longer emitted")
     assert not gone, f"legacy allowlist entries no longer emitted: {gone}"

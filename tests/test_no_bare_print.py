@@ -1,63 +1,33 @@
-"""Lint-style guard: no bare ``print(`` calls in ``tensordiffeq_tpu/``.
+"""Lint guard: no bare ``print(`` calls in ``tensordiffeq_tpu/``.
 
-All package narration routes through ``telemetry.log_event`` (leveled,
-honours ``verbose``, mirrored into the active JSONL sink) so quiet runs
-are quiet and events are machine-readable.  The only places allowed to
-call ``print`` directly are the telemetry package itself (it implements
-the narration path) and ``training/progress.py`` (the tqdm-free progress
-bar, whose output is the progress UI, not narration).
-
-AST-based, so docstrings/comments mentioning print() don't false-positive.
-Fast (<1s) — runs in tier-1 as the CI check for this rule.
+Since PR 12 this is a thin wrapper over the tdqlint engine's
+``no-bare-print`` rule (one walker, one suppression syntax — see
+``tensordiffeq_tpu/analysis/``); the test names are kept so CI history
+stays comparable.  Rationale unchanged: all package narration routes
+through ``telemetry.log_event`` so quiet runs are quiet and events are
+machine-readable; only the telemetry package, the progress bar, and the
+lint CLI (whose stdout is its product) may print.
 """
 
-import ast
-import os
-
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "tensordiffeq_tpu")
-
-# paths (relative to the package root) where print() stays legal
-ALLOWED = ("telemetry" + os.sep, os.path.join("training", "progress.py"))
-
-
-def _print_calls(path):
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    return [node.lineno for node in ast.walk(tree)
-            if isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name) and node.func.id == "print"]
-
-
-def _scan():
-    violations, scanned = [], set()
-    for root, _dirs, files in os.walk(PKG):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, PKG)
-            if rel.startswith(ALLOWED[0]) or rel == ALLOWED[1]:
-                continue
-            scanned.add(rel)
-            for lineno in _print_calls(path):
-                violations.append(f"tensordiffeq_tpu/{rel}:{lineno}")
-    return violations, scanned
+from tensordiffeq_tpu.analysis import run_analysis
+from tensordiffeq_tpu.analysis.rules import NoBarePrintRule
 
 
 def test_no_bare_print_outside_telemetry():
-    violations, _ = _scan()
-    assert not violations, (
+    findings, _ = run_analysis(select=["no-bare-print"])
+    assert not findings, (
         "bare print() calls found (route them through telemetry.log_event "
         "so quiet runs stay quiet and events reach the JSONL sink):\n  "
-        + "\n  ".join(violations))
+        + "\n  ".join(f.format() for f in findings))
 
 
 def test_guard_covers_serving_and_fleet():
     """The guard's coverage is part of its contract: the serving and
     fleet packages (operator-facing, narration-heavy) must be inside the
     scanned set, not accidentally excluded by a future allowlist edit."""
-    _, scanned = _scan()
+    _, modules = run_analysis(select=["no-bare-print"])
+    rule = NoBarePrintRule()
+    scanned = {m.pkg_rel() for m in modules if rule.files(m)}
     for sub in ("serving", "fleet"):
-        assert any(rel.startswith(sub + os.sep) for rel in scanned), \
+        assert any(rel.startswith(sub + "/") for rel in scanned), \
             f"{sub}/ fell out of the bare-print guard's coverage"

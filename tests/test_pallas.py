@@ -175,32 +175,16 @@ def test_every_pallas_kernel_has_interpret_mode_test():
     (``pallas_call``) must be exercised by an interpret-mode CPU test in
     THIS file.  Interpret mode is the only pre-hardware signal tier-1 has
     — it already missed three Mosaic-only failures once (PERF.md); zero
-    coverage would miss everything."""
-    import os
-    import re
+    coverage would miss everything.  Since PR 12 the walker lives in the
+    tdqlint engine (``pallas-interpret-coverage`` rule); this wrapper
+    keeps the test name so CI history stays comparable."""
+    from tensordiffeq_tpu.analysis import run_analysis
 
-    import tensordiffeq_tpu.ops as ops_pkg
-    ops_dir = os.path.dirname(ops_pkg.__file__)
-    with open(__file__) as fh:
-        this_src = fh.read()
-    missing = []
-    for fn in sorted(os.listdir(ops_dir)):
-        if not fn.endswith(".py"):
-            continue
-        with open(os.path.join(ops_dir, fn)) as fh:
-            src = fh.read()
-        if not re.search(r"\bpallas_call\s*\(", src):
-            continue
-        mod = fn[:-3]
-        # registered = this file imports the module AND drives something
-        # from it under interpret=True (the import is the anchor; every
-        # kernel builder here takes interpret=)
-        if f"ops.{mod} import" not in this_src:
-            missing.append(mod)
-    assert "interpret=True" in this_src
-    assert not missing, (
-        f"ops modules with a pallas_call but no interpret-mode test "
-        f"registered in tests/test_pallas.py: {missing}")
+    findings, _ = run_analysis(select=["pallas-interpret-coverage"])
+    assert not findings, (
+        "ops modules with a pallas_call but no interpret-mode test "
+        "registered in tests/test_pallas.py:\n  "
+        + "\n  ".join(f.format() for f in findings))
 
 
 def test_pallas_point_cotangent_matches_xla():

@@ -32,7 +32,7 @@ from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
 from . import parallel, plotting, profiling, sampling, telemetry  # noqa: F401
 from . import resilience, training, utils  # noqa: F401
-from . import fleet, models, serving  # noqa: F401
+from . import factory, fleet, models, serving  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
 from .domains import DomainND  # noqa: F401
@@ -44,6 +44,7 @@ from .ops import (MSE, UFn, d, g_MSE, grad, laplacian,  # noqa: F401
                   set_default_grad_mode)
 from .resilience import (Chaos, CircuitBreaker, Preempted,  # noqa: F401
                          PreemptionHandler, ResilientFit, RetryPolicy)
+from .factory import SurrogateFactory  # noqa: F401
 from .fleet import (AdmissionController, AdmissionRejected,  # noqa: F401
                     FleetRouter, TenantPolicy)
 from .serving import (ArtifactVersionMismatch, InferenceEngine,  # noqa: F401

@@ -21,6 +21,11 @@ costs milliseconds and needs no XLA compile (the same
   (the 163ms→1.8ms stall win is exactly "no host round-trip here").
 * ``serving-u`` / ``serving-residual`` — the engine's per-kind bucket
   programs (the fleet's zero-request-time-compile path).
+* ``vmapped-factory-step`` — the surrogate factory's family chunk
+  runner (PR 15): the minimax member loss vmapped over the model axis
+  with per-member divergence masking, scanned for two steps.  "One
+  program per family step" is the factory's whole throughput claim;
+  a host hop here would serialize all M members on it.
 
 jax is imported lazily inside functions: importing this module (or the
 rest of :mod:`tensordiffeq_tpu.analysis`) stays stdlib-only.
@@ -199,11 +204,75 @@ def _serving_program(kind: str):
     return builder
 
 
+def _factory_program():
+    """The surrogate factory's vmapped family step (2 members, 2 scanned
+    optimizer steps, minimax member loss with a traced θ and the
+    per-member divergence mask) — built WITHOUT a template solver so the
+    trace stays compile-free."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..factory.family import make_family_runner, stack_members
+    from ..ops.derivatives import grad
+    from ..ops.fused import analyze_f_model
+    from ..ops.pallas_minimax import (build_minimax_sq_fn,
+                                      make_minimax_residual_loss)
+    from ..training.fit import make_optimizer
+
+    def f_model(u, x, t, th):  # AC-type with the family coefficient θ
+        return (grad(u, "t")(x, t)
+                - th * grad(grad(u, "x"), "x")(x, t)
+                + u(x, t) ** 3 - u(x, t))
+
+    net, _ = _micro_net(seed=3)
+    reqs = analyze_f_model(lambda u, x, t: f_model(u, x, t, 0.05),
+                           ("x", "t"), 1)
+    shapes = [(2, 8), (8, 8), (8, 1)]
+    M, N = 2, 16
+
+    def member_vg(tr_m, X_m, theta):
+        def lo(tr):
+            sq = build_minimax_sq_fn(
+                lambda u, x, t: f_model(u, x, t, theta),
+                ("x", "t"), 1, reqs, shapes, use_pallas=False)
+            mm = make_minimax_residual_loss(sq)
+            total = mm(tr["params"], tr["lambdas"]["residual"], X_m)
+            return total, {"Total Loss": total}
+        (total, comps), grads = jax.value_and_grad(
+            lo, has_aux=True)(tr_m)
+        return total, comps, grads, optax.global_norm(grads)
+
+    opt = make_optimizer()
+    params = stack_members(
+        [net.init(jax.random.PRNGKey(m), jnp.zeros((1, 2)))
+         for m in range(M)])
+    trainables = {"params": params,
+                  "lambdas": {"residual": [jnp.ones((M, N, 1))],
+                              "BCs": []}}
+    opt_state = opt.init(trainables)
+    alive = jnp.ones((M,), bool)
+    best = (jax.tree_util.tree_map(jnp.array, params),
+            jnp.full((M,), jnp.inf), jnp.full((M,), -1, jnp.int32))
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(M, N, 2) * 0.5, jnp.float32)
+    thetas = jnp.asarray([0.01, 0.05], jnp.float32)
+    run = make_family_runner(member_vg, opt, M)
+
+    def step(trainables, opt_state, alive, best, X, thetas):
+        return run(trainables, opt_state, alive, best, X, thetas,
+                   jnp.asarray(0), 2)
+
+    return step, (trainables, opt_state, alive, best, X, thetas)
+
+
 HOT_PROGRAMS = {
     "fused-minimax-step": _minimax_program,
     "device-resampler": _resampler_program,
     "serving-u": _serving_program("u"),
     "serving-residual": _serving_program("residual"),
+    "vmapped-factory-step": _factory_program,
 }
 
 

@@ -1,0 +1,14 @@
+"""The surrogate factory: vmapped many-model training (ROADMAP item 3).
+
+Train a parametric family of small PINNs as ONE sharded program — stack
+per-member parameters along a model axis, ``vmap`` the adopted loss
+engine (fused minimax step where the problem qualifies) over it, and
+fill the chip the way a single 500k-point problem does.  The output is
+an artifact *batch* that loads straight into the serving fleet.
+
+See :mod:`tensordiffeq_tpu.factory.family` for the design rationale and
+docs/api.md ("Surrogate factory") for the user surface.
+"""
+
+from .family import (FAMILY_MANIFEST, SurrogateFactory,  # noqa: F401
+                     make_family_runner, member_slice, stack_members)

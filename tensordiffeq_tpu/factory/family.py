@@ -1,0 +1,1084 @@
+"""The surrogate factory: vmapped many-model training that fills a chip
+with a parametric family of PINNs.
+
+PERF.md's scale sweep shows one chip absorbs ``N_f`` up to 500k at flat
+throughput — a single small PINN underfills the hardware.  The production
+workload ROADMAP describes ("users ask for *their* coefficients") is a
+neighborhood of small related problems, so the factory trains a
+**parametric family of surrogates as one sharded program**:
+
+* per-member network parameters (and SA λ, Adam moments, collocation
+  sets) are stacked along a leading **model axis**;
+* the fused minimax step (:mod:`..ops.pallas_minimax`) — or the fused /
+  generic residual engine, whichever the problem's template solver
+  adopts — is ``jax.vmap``-ed over that axis, so a sweep of 64 small
+  PINNs runs as ONE jitted train step the way one 500k-point problem
+  does (the benchmark-breadth argument of PINNs-TF2, arXiv:2311.03626);
+* the family parameter θ (PDE coefficients) rides as a *traced operand*
+  of the vmapped step: one compiled program serves every member.
+
+Correctness discipline mirrors the solver's engine adoption: the family
+step is **cross-checked member-by-member against the template solver's
+loss** at build time (value and gradients — a traced θ or a batching bug
+would show up as an O(1) disagreement), and a **1-member family runs the
+member program unbatched** (vmap's batched matmul transposes accumulate
+in a different order, so bit-identity with the plain solver — the
+subsystem's correctness anchor, pinned in ``tests/test_factory.py`` —
+requires the degenerate family to BE the plain program).
+
+Robustness: a member whose loss or gradient goes non-finite is
+**frozen** — its parameters, λ, and Adam moments stop updating (a
+per-member ``jnp.where`` select, inside the jitted scan) while the rest
+of the family trains on.  vmap lanes are independent, so a NaN member
+cannot poison its neighbors (pinned bit-exact in tests).  Frozen members
+are reported through the ``factory.*`` telemetry instruments and
+excluded from :meth:`SurrogateFactory.export_family`.
+
+Per-member adaptive collocation batches PR 10's jitted
+pool→score→select program over the model axis
+(:class:`~tensordiffeq_tpu.ops.resampling.FamilyResampler`): each member
+redraws its own ``X_f`` by residual importance, per-member λ and λ-ascent
+moments carried through the redraw, double-buffered behind the training
+chunks exactly like the single-model path.
+
+The product is an artifact *batch*: :meth:`~SurrogateFactory.
+export_family` slices each member into a v2 AOT fleet artifact
+(:func:`~tensordiffeq_tpu.fleet.export_fleet_artifact`) so the factory's
+output loads directly into :class:`~tensordiffeq_tpu.fleet.FleetRouter`
+(``FleetRouter.register_family``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.collocation import CollocationSolverND
+from ..telemetry import as_training_telemetry, log_event
+from ..training.fit import FitResult, make_optimizer
+from ..utils import tree_copy
+
+#: family manifest filename written by export_family (what
+#: FleetRouter.register_family reads)
+FAMILY_MANIFEST = "family_manifest.json"
+
+
+def stack_members(trees: Sequence) -> any:
+    """Stack a sequence of identically-structured pytrees along a new
+    leading **model axis** (``None`` leaves stay ``None`` — shared,
+    non-adaptive λ terms)."""
+    def _stack(*xs):
+        if xs[0] is None:
+            if any(x is not None for x in xs):
+                raise ValueError("members disagree on which λ terms are "
+                                 "adaptive; the family must share one "
+                                 "adaptive configuration")
+            return None
+        return jnp.stack([jnp.asarray(x) for x in xs])
+    return jax.tree_util.tree_map(_stack, *trees,
+                                  is_leaf=lambda x: x is None)
+
+
+def member_slice(tree, m: int):
+    """Member ``m``'s slice of a model-axis-stacked pytree (``None``
+    leaves pass through)."""
+    return jax.tree_util.tree_map(
+        lambda a: None if a is None else a[m], tree,
+        is_leaf=lambda x: x is None)
+
+
+def _squeeze0(tree):
+    """Drop the leading model axis of every array leaf (M == 1 path)."""
+    return jax.tree_util.tree_map(
+        lambda a: None if a is None else a[0], tree,
+        is_leaf=lambda x: x is None)
+
+
+def _unsqueeze0(tree):
+    return jax.tree_util.tree_map(
+        lambda a: None if a is None else a[None], tree,
+        is_leaf=lambda x: x is None)
+
+
+def _squeeze_state(tree):
+    """Drop a length-1 leading member axis where present (optimizer
+    state: stacked mu/nu carry it; scalar step counts do not)."""
+    return jax.tree_util.tree_map(
+        lambda a: a[0] if getattr(a, "ndim", 0) >= 1 and a.shape[0] == 1
+        else a, tree)
+
+
+def _unsqueeze_state(tree, ref):
+    """Restack a squeezed optimizer state: re-add the member axis
+    exactly where ``ref`` (an ``eval_shape`` of the stacked init)
+    carries one more dimension."""
+    return jax.tree_util.tree_map(
+        lambda a, r: a[None] if len(r.shape) == getattr(a, "ndim", 0) + 1
+        else a, tree, ref)
+
+
+def _select_members(ok, new, old, n_members: int):
+    """Per-member pytree select: leaves with a leading model axis pick
+    ``new`` where ``ok`` (their member's lane) else ``old``; axis-less
+    leaves (optimizer step counts) always take ``new``.  The model axis
+    is identified structurally — every stacked leaf was built with
+    leading length ``n_members`` — so a scalar Adam ``count`` passes
+    through untouched."""
+    def sel(n, o):
+        if n is None:
+            return None
+        if getattr(n, "ndim", 0) >= 1 and n.shape[0] == n_members:
+            k = ok.reshape((n_members,) + (1,) * (n.ndim - 1))
+            return jnp.where(k, n, o)
+        return n
+    return jax.tree_util.tree_map(sel, new, old,
+                                  is_leaf=lambda x: x is None)
+
+
+def make_family_runner(member_vg: Callable, opt, n_members: int):
+    """Build the jitted family chunk runner (M > 1).
+
+    ``member_vg(trainables_m, X_m, theta_m) -> (total, comps, grads,
+    gnorm)`` is the per-member loss+grad, ``jax.vmap``-ed over the model
+    axis.  (A 1-member family does NOT come through here — it reuses
+    ``training.fit._chunk_runner``, the solver's own compiled step, so
+    the degenerate family is bit-identical to the plain fit by
+    construction; even an unbatched re-implementation of the same math
+    fuses differently under XLA and drifts in the last ulp.)
+
+    Returns ``run(trainables, opt_state, alive, best, X, thetas, step0,
+    n_steps)`` executing ``n_steps`` vmapped optimizer steps in one
+    ``lax.scan``, with per-member divergence masking: a member whose
+    loss or gradient norm goes non-finite is frozen — parameters, λ and
+    Adam moments stop updating for that member only (``alive`` is
+    sticky).  ``best`` carries per-member ``(params, best_loss,
+    best_step)``."""
+    from functools import partial
+
+    family_vg = jax.vmap(member_vg)
+
+    @partial(jax.jit, static_argnames=("n_steps",),
+             donate_argnums=(0, 1, 2, 3))
+    def run(trainables, opt_state, alive, best, X, thetas, step0,
+            n_steps: int):
+        def step(carry, i):
+            trainables, opt_state, alive, best = carry
+            totals, comps, grads, gnorms = family_vg(trainables, X, thetas)
+            # divergence mask: sticky per-member freeze the moment the
+            # loss OR the gradient goes non-finite — the update below is
+            # computed for every lane (lanes are independent; a NaN lane
+            # cannot poison its neighbors) and selected away per member
+            ok = alive & jnp.isfinite(totals) & jnp.isfinite(gnorms)
+            updates, new_opt = opt.update(grads, opt_state, trainables)
+            new_tr = optax.apply_updates(trainables, updates)
+            trainables = _select_members(ok, new_tr, trainables, n_members)
+            opt_state = _select_members(ok, new_opt, opt_state, n_members)
+
+            best_params, best_loss, best_step = best
+            improved = ok & (totals < best_loss)
+            best = (
+                _select_members(improved, trainables["params"], best_params,
+                                n_members),
+                jnp.where(improved, totals, best_loss),
+                jnp.where(improved, step0 + i, best_step),
+            )
+            out = {**comps, "Grad_norm": gnorms,
+                   "Alive": ok.astype(jnp.float32)}
+            return (trainables, opt_state, ok, best), out
+
+        (trainables, opt_state, alive, best), comps = jax.lax.scan(
+            step, (trainables, opt_state, alive, best),
+            jnp.arange(n_steps))
+        return trainables, opt_state, alive, best, comps
+
+    return run
+
+
+class SurrogateFactory:
+    """Train a parametric family of PINN surrogates as ONE program.
+
+    Args:
+      layer_sizes: per-member MLP sizes (every member shares the
+        architecture — the model axis stacks parameters, not programs).
+      f_model: the family residual ``f_model(u, *coords, theta)`` —
+        the plain solver signature with the member's family parameter
+        appended (a scalar, array, or pytree of arrays; PDE
+        coefficients are the canonical axis).  BC-parameter and
+        geometry-scale axes reduce to this form by writing the BC into
+        the residual; structurally distinct per-member BCs are out of
+        scope (the family shares ``bcs``).
+      domain / bcs: the shared problem geometry (collocation points
+        generated; every member starts from the same draw and diverges
+        under per-member adaptive resampling).
+      thetas: sequence of ``M`` family-parameter values (one per
+        member), stacked along the model axis.
+      Adaptive_type / dict_adaptive / init_weights / g: the solver's SA
+        contract, applied PER MEMBER (each member trains its own λ).
+        NTK weighting (type 3) is not supported on the family path.
+      dist: shard the MODEL axis over devices — ``True`` = every global
+        device, an int = the first that many, a device sequence as
+        given (:func:`~tensordiffeq_tpu.parallel.resolve_mesh`; ``M``
+        must divide evenly).  Each device owns ``M / n_dev`` members'
+        full training state; the vmapped step runs model-parallel with
+        no cross-member collectives inside the step.  Checkpoints ride
+        the topology-portable per-shard layout, so an 8-device family
+        checkpoint restores onto a 4-device mesh (pinned in tests).
+      fused / minimax: engine selection forwarded to the TEMPLATE
+        solver (member 0's concrete θ); the adopted engine — fused
+        minimax step, fused Taylor residual, or the generic autodiff
+        engine — is what the family step vmaps.
+      seed: member ``m`` initializes its network with
+        ``PRNGKey(seed + m)``, so ``CollocationSolverND(seed=seed + m)``
+        is the member's matched-seed solo reference.
+
+    The member loss is cross-checked against the template solver's loss
+    at build time (value + gradients on a sample of the real collocation
+    set, per the engine-adoption discipline of
+    ``CollocationSolverND._crosscheck_fused``).
+    """
+
+    def __init__(self, layer_sizes: Sequence[int], f_model: Callable,
+                 domain, bcs: Sequence, thetas: Sequence,
+                 Adaptive_type: int = 0,
+                 dict_adaptive: Optional[dict] = None,
+                 init_weights: Optional[dict] = None,
+                 g: Optional[Callable] = None,
+                 dist=False,
+                 lr: float = 0.005, lr_weights: float = 0.005,
+                 fused: Optional[bool] = None,
+                 minimax: Optional[bool] = None,
+                 seed: int = 0, verbose: bool = True):
+        if len(thetas) < 1:
+            raise ValueError("a family needs at least one member "
+                             "(thetas is empty)")
+        if Adaptive_type == 3:
+            raise ValueError(
+                "NTK weighting (Adaptive_type=3) recomputes λ between "
+                "chunks on the host and is not supported on the vmapped "
+                "family path; use 0, 1 or 2")
+        self.n_members = len(thetas)
+        self.member_thetas = [jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.float32), t) for t in thetas]
+        self.thetas = stack_members(self.member_thetas)
+        self.f_model = f_model
+        self.seed = int(seed)
+        self.verbose = verbose
+        self.lr, self.lr_weights = lr, lr_weights
+        self.domain = domain
+        self.layer_sizes = list(layer_sizes)
+
+        # -- template solver: member 0's concrete θ baked in.  Engine
+        # adoption (fused Taylor residual / fused minimax step, each
+        # behind its numeric cross-check), λ semantics, and the loss
+        # assembly are all decided HERE and reproduced for the family —
+        # the factory adds the model axis, never a second code path.
+        theta0 = self.member_thetas[0]
+
+        def f0(u, *coords):
+            return f_model(u, *coords, theta0)
+
+        tpl = CollocationSolverND(verbose=False, seed=self.seed)
+        tpl.compile(list(layer_sizes), f0, domain, list(bcs),
+                    Adaptive_type=Adaptive_type,
+                    dict_adaptive=dict_adaptive, init_weights=init_weights,
+                    g=g, lr=lr, lr_weights=lr_weights, fused=fused,
+                    minimax=minimax)
+        self._template = tpl
+        self.Adaptive_type = Adaptive_type
+        self.engine = ("fused-minimax" if tpl._minimax_kind is not None
+                       else "fused" if tpl._fused_residual is not None
+                       else "generic")
+        self.net = tpl.net
+        self.apply_fn = tpl.apply_fn
+        self.n_out = tpl.n_out
+        self.varnames = tuple(domain.vars)
+
+        # -- stacked per-member state: params (PRNGKey(seed + m)), λ
+        # (each member its own copy of the init), X_f (the shared draw;
+        # per-member resampling diverges them), alive mask
+        ndim = domain.ndim
+        members = []
+        for m in range(self.n_members):
+            members.append(self.net.init(
+                jax.random.PRNGKey(self.seed + m),
+                jnp.zeros((1, ndim), jnp.float32)))
+        self.params = stack_members(members)
+        self.lambdas = stack_members(
+            [tree_copy(tpl.lambdas) for _ in range(self.n_members)])
+        X0 = jnp.asarray(domain.X_f, jnp.float32)
+        self.X_f = jnp.array(jnp.broadcast_to(
+            X0[None], (self.n_members,) + X0.shape))
+        self.alive = jnp.ones((self.n_members,), bool)
+        self.opt_state = None
+        self.losses: list[dict] = []
+        self.frozen_at: dict[int, int] = {}  # member -> epoch frozen
+        self.best = None  # (params[M,...], loss[M], step[M])
+
+        self._build_member_fns()
+        # one optimizer + one compiled runner per factory: fit() calls
+        # share them, so a second fit() (or a resumed one) reuses the
+        # compiled chunk program instead of re-tracing
+        self._opt = make_optimizer(self.lr, self.lr_weights)
+        self._runner = None
+        self._mesh = None
+        if dist:
+            from ..parallel import resolve_mesh
+            self._mesh = resolve_mesh(dist)
+            n_dev = int(np.prod(self._mesh.devices.shape))
+            if self.n_members % n_dev:
+                raise ValueError(
+                    f"n_members={self.n_members} must divide evenly over "
+                    f"the {n_dev}-device mesh (each device owns "
+                    "M/n_dev members)")
+            self._place_family()
+        if self.n_members > 1:
+            ok, why = self._crosscheck_family()
+            if not ok:
+                raise ValueError(
+                    "the vmapped family step disagrees with the template "
+                    "solver's loss on member 0 — the traced-θ member loss "
+                    "is broken") from why
+        log_event("factory", f"family of {self.n_members} compiled "
+                  f"({self.engine} engine, "
+                  f"{'model-sharded' if self._mesh is not None else 'single-device'})",
+                  verbose=self.verbose, members=self.n_members,
+                  engine=self.engine)
+
+    # ------------------------------------------------------------------ #
+    def _model_sharding(self, leaf_ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import DATA_AXIS
+        return NamedSharding(
+            self._mesh, P(DATA_AXIS, *(None,) * (leaf_ndim - 1)))
+
+    def _place_family(self):
+        """Place every model-stacked leaf with its model-axis sharding
+        (the leading axis splits over the mesh; each device owns whole
+        members).  Leaves without the member axis — optimizer step
+        counts — stay replicated."""
+        M = self.n_members
+
+        def place(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a if a is None else (
+                    jax.device_put(jnp.asarray(a),
+                                   self._model_sharding(np.ndim(a)))
+                    if np.ndim(a) >= 1 and np.shape(a)[0] == M else
+                    jnp.asarray(a)),
+                tree, is_leaf=lambda x: x is None)
+        self.params = place(self.params)
+        self.lambdas = place(self.lambdas)
+        self.X_f = place(self.X_f)
+        self.thetas = place(self.thetas)
+        self.alive = jax.device_put(jnp.asarray(self.alive),
+                                    self._model_sharding(1))
+        if self.opt_state is not None:
+            self.opt_state = place(self.opt_state)
+        if self.best is not None:
+            self.best = tuple(place(b) for b in self.best)
+
+    # ------------------------------------------------------------------ #
+    def _build_member_fns(self):
+        """Build the per-member loss/residual with θ as a traced operand,
+        reproducing the template's adopted engine (the M == 1 path reuses
+        the template's own loss so the degenerate family IS the plain
+        program — the bit-identity anchor)."""
+        from ..models.assembly import build_loss_fn
+        from ..ops.derivatives import make_ufn, vmap_residual
+
+        tpl = self._template
+        f_model = self.f_model
+        varnames, n_out = list(self.varnames), self.n_out
+        apply_fn = self.apply_fn
+        ndim = len(varnames)
+        bcs = tpl.bcs
+        wos, g = tpl.weight_outside_sum, tpl.g
+        reqs = getattr(tpl, "_fuse_requests", None)
+        shapes = getattr(tpl, "_fuse_shapes", None)
+        precision = self.net.precision
+
+        def bind(theta):
+            return lambda u, *coords: f_model(u, *coords, theta)
+
+        def member_loss(params, lam_bcs, lam_res, X, theta):
+            f_m = bind(theta)
+            if self.engine == "fused-minimax":
+                from ..ops import pallas_minimax as pmm
+                sq = pmm.build_minimax_sq_fn(
+                    f_m, varnames, n_out, reqs, shapes,
+                    precision=precision, use_pallas=False,
+                    flat_matmul=True)
+                mm = pmm.make_minimax_residual_loss(
+                    sq, weight_outside_sum=wos, g=g)
+                loss_fn = build_loss_fn(apply_fn, varnames, n_out, f_m,
+                                        bcs, weight_outside_sum=wos, g=g,
+                                        residual_loss_fn=mm)
+            elif self.engine == "fused":
+                from ..ops.fused import make_fused_residual
+                res = make_fused_residual(f_m, varnames, n_out, reqs,
+                                          precision=precision)
+                loss_fn = build_loss_fn(apply_fn, varnames, n_out, f_m,
+                                        bcs, weight_outside_sum=wos, g=g,
+                                        residual_fn=res)
+            else:
+                loss_fn = build_loss_fn(apply_fn, varnames, n_out, f_m,
+                                        bcs, weight_outside_sum=wos, g=g)
+            return loss_fn(params, lam_bcs, lam_res, X)
+
+        def member_loss_single(params, lam_bcs, lam_res, X, theta):
+            # degenerate M == 1 family: the template's OWN loss (θ baked
+            # as a constant) — same jaxpr as the plain solver, which is
+            # what makes the 1-member fit bit-identical to it
+            del theta
+            return tpl.loss_fn(params, lam_bcs, lam_res, X)
+
+        self._member_loss = member_loss
+        loss = member_loss_single if self.n_members == 1 else member_loss
+
+        def member_vg(tr_m, X_m, theta):
+            def lo(tr):
+                lam = tr["lambdas"]
+                return loss(tr["params"], lam["BCs"], lam["residual"],
+                            X_m, theta)
+            (total, comps), grads = jax.value_and_grad(
+                lo, has_aux=True)(tr_m)
+            return total, comps, grads, optax.global_norm(grads)
+
+        self._member_vg = member_vg
+
+        # per-member residual with θ traced — the family resampler's
+        # scoring engine (same flavor the template adopted for scoring)
+        fused_res = tpl._fused_residual is not None
+
+        def member_residual(params, X, theta):
+            f_m = bind(theta)
+            if fused_res:
+                from ..ops.fused import make_fused_residual
+                return make_fused_residual(f_m, varnames, n_out, reqs,
+                                           precision=precision)(params, X)
+            u = make_ufn(apply_fn, params, varnames, n_out)
+            return vmap_residual(f_m, u, ndim)(X)
+
+        self._member_residual = member_residual
+
+    # ------------------------------------------------------------------ #
+    def _crosscheck_family(self, n_check: int = 32):
+        """Compare member 0's lane of the vmapped traced-θ loss (value
+        AND gradients) against the template solver's loss on a sample of
+        the real collocation set — the same numeric gate the solver
+        applies before adopting a fused engine, applied to the model
+        axis.  vmap's batched transposes legitimately reorder matmul
+        accumulation, so the band is the f32 contraction-order band, not
+        bitwise."""
+        from ..ops.fused import FusedMismatch, crosscheck_grads
+
+        tpl = self._template
+        n_s = min(n_check, int(np.shape(tpl.X_f)[0]))
+        X_s = jnp.asarray(np.asarray(tpl._sync_X_f_host()[:n_s]))
+        lam_res = [None if lam is None else
+                   (lam[:n_s] if getattr(lam, "ndim", 0) >= 1
+                    and lam.shape[0] == np.shape(tpl.X_f)[0] else lam)
+                   for lam in tpl.lambdas.get("residual", [])]
+        lam_bcs = list(tpl.lambdas.get("BCs", []))
+        p0 = member_slice(self.params, 0)
+        theta0 = self.member_thetas[0]
+
+        def tpl_loss(p, lr_):
+            return tpl.loss_fn(p, lam_bcs, lr_, X_s)[0]
+
+        def fam_loss(p, lr_):
+            return self._member_loss(p, lam_bcs, lr_, X_s, theta0)[0]
+
+        v_t, g_t = jax.value_and_grad(tpl_loss, argnums=(0, 1))(p0, lam_res)
+        try:
+            # through vmap, exactly as the family step runs it
+            def lane(p, lr_, X, th):
+                return jax.value_and_grad(
+                    lambda q, s: self._member_loss(q, lam_bcs, s, X,
+                                                   th)[0],
+                    argnums=(0, 1))(p, lr_)
+            v_f, g_f = jax.vmap(lane)(
+                _unsqueeze0(p0), _unsqueeze0(lam_res), X_s[None],
+                _unsqueeze0(theta0))
+            v_f = v_f[0]
+            g_f = _squeeze0(g_f)
+        except Exception as e:
+            return False, e
+        err = abs(float(v_f) - float(v_t))
+        if not (err <= 1e-5 + 5e-3 * abs(float(v_t))):
+            return False, FusedMismatch(
+                f"family loss {float(v_f):.6e} disagrees with the "
+                f"template's {float(v_t):.6e} on member 0")
+        return crosscheck_grads(g_t, g_f)
+
+    # ------------------------------------------------------------------ #
+    def fit(self, tf_iter: int, chunk: int = 100,
+            resample_every: int = 0, resample_pool: int = 4,
+            resample_temp: float = 1.0, resample_uniform: float = 0.1,
+            resample_seed: int = 0,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 0,
+            telemetry=None, converge_loss: Optional[float] = None):
+        """Train the whole family: ``tf_iter`` vmapped Adam(+SA minimax)
+        epochs as on-device ``lax.scan`` chunks — one jitted program per
+        chunk for ALL members.
+
+        ``resample_every``: per-member adaptive collocation — PR 10's
+        pool→score→select program batched over the model axis
+        (:class:`~tensordiffeq_tpu.ops.resampling.FamilyResampler`),
+        double-buffered behind the training chunks (dispatch at the due
+        boundary, swap at the next); per-member λ and λ-ascent moments
+        carry through each member's redraw.
+
+        ``telemetry``: a :class:`~tensordiffeq_tpu.telemetry.
+        TrainingTelemetry` (or bare RunLogger).  The family emits the
+        ``factory.*`` instruments — per-member loss quantiles, frozen /
+        converged member gauges, aggregate family points/s — plus the
+        standard ``cost.*`` gauges with the vmapped step priced at its
+        family-exact FLOP count (the analytic floor and the minimax
+        fallback both scale by ``n_members``, so ``cost.mfu`` stays
+        honest for the batched program).
+
+        ``converge_loss``: threshold for the ``factory.members_converged``
+        gauge (a member counts once its latest loss is at or below it).
+
+        Divergence semantics: a non-finite member is frozen and training
+        continues; :class:`~tensordiffeq_tpu.telemetry.TrainingDiverged`
+        is raised only when EVERY member has frozen (there is nothing
+        left to train).
+        """
+        import time as _time
+
+        tele = as_training_telemetry(telemetry)
+        epochs_at_entry = len(self.losses)
+        M, N = self.n_members, int(self.X_f.shape[1])
+        single = (M == 1)
+
+        opt = self._opt
+        trainables = tree_copy({"params": self.params,
+                                "lambdas": self.lambdas})
+        if self.opt_state is None:
+            opt_state = opt.init(trainables)
+            if self._mesh is not None:
+                opt_state = jax.tree_util.tree_map(
+                    lambda a: (jax.device_put(
+                        a, self._model_sharding(a.ndim))
+                        if getattr(a, "ndim", 0) >= 1
+                        and a.shape[0] == M else a),
+                    opt_state)
+        else:
+            opt_state = tree_copy(self.opt_state)
+        # copies: the runner donates its carried state and the factory's
+        # own arrays (alive mask, restored best) must stay valid
+        alive = jnp.array(self.alive)
+        best = None if self.best is None else tuple(
+            tree_copy(b) for b in self.best)
+        if best is None:
+            # explicit dtype: a weak-typed inf fill would give the first
+            # fit a different jit key than every later (runner-output)
+            # fit and cost one silent recompile
+            best = (tree_copy(trainables["params"]),
+                    jnp.full((M,), jnp.inf, jnp.float32),
+                    jnp.full((M,), -1, jnp.int32))
+        X_f, thetas = self.X_f, self.thetas
+
+        if single:
+            # degenerate family: reuse the solver's OWN compiled chunk
+            # runner on the squeezed state — the 1-member fit is then
+            # bit-identical to the plain CollocationSolverND fit by
+            # construction (the correctness anchor; see
+            # make_family_runner's docstring for why a re-implementation
+            # cannot be).  The stacked [1, N, d] X_f already IS the
+            # runner's [n_batches=1, bsz, d] batch layout.
+            from ..training.fit import _chunk_runner
+            if self._runner is None:
+                self._runner = _chunk_runner(self._template.loss_fn, opt,
+                                             n_batches=1, n_points=N)
+            run1 = self._runner
+            idx_b = jnp.arange(N).reshape(1, N)
+            # shape reference for restacking the optimizer state (only
+            # leaves that carried the member axis get it back)
+            opt_ref = jax.eval_shape(opt.init, trainables)
+            trainables = _squeeze0(trainables)
+            opt_state = _squeeze_state(opt_state)
+            best = (_squeeze0(best[0]), best[1][0], best[2][0])
+        else:
+            if self._runner is None:
+                self._runner = make_family_runner(self._member_vg, opt, M)
+            run = self._runner
+
+        sampler = None
+        pending = None
+        res_flops = {"v": None}
+        if resample_every > 0:
+            from ..ops.resampling import FamilyResampler
+            sampler = FamilyResampler(
+                self._member_residual, self.domain.xlimits, N, M,
+                pool_factor=resample_pool, temp=resample_temp,
+                uniform_frac=resample_uniform, seed=resample_seed)
+
+        def resample_flops(p_stacked, X, th):
+            """``(flops, basis)`` of one family redraw — credited to the
+            overlapped chunk so ``cost.mfu`` doesn't read the redraw's
+            device time as idle (the PR-10 accounting, family-sized:
+            the analytic floor is one forward over every member's
+            pool)."""
+            if res_flops["v"] is None:
+                from ..telemetry.costmodel import (analytic_mlp_flops,
+                                                   program_cost,
+                                                   resolve_flop_basis)
+                n_pool = sampler.n_f + sampler.n_fresh
+                floor = M * analytic_mlp_flops(self.layer_sizes, n_pool)
+                measured = None
+                try:
+                    measured = program_cost(
+                        sampler.lower_redraw(p_stacked, X, th))["flops"]
+                except Exception:
+                    pass
+                res_flops["v"] = resolve_flop_basis(
+                    measured, floor,
+                    fallback=lambda: (floor, "analytic-resample"))
+            return res_flops["v"]
+
+        if tele is not None:
+            from ..telemetry.costmodel import analytic_step_floor
+            tele.cost_floor = M * analytic_step_floor(N, self.layer_sizes)
+            if self.engine == "fused-minimax":
+                from ..ops.pallas_minimax import n_channels
+                from ..telemetry.costmodel import analytic_minimax_flops
+                tele.cost_fallback = (
+                    M * analytic_minimax_flops(
+                        self.layer_sizes, N,
+                        n_channels(self._template._fuse_requests)),
+                    "analytic-minimax")
+            tele.on_fit_start(dict(
+                tf_iter=tf_iter, n_members=M, N_f=N,
+                layer_sizes=list(self.layer_sizes),
+                Adaptive_type=self.Adaptive_type,
+                engine=f"family-{self.engine}",
+                resample_every=resample_every,
+                prior_epochs=epochs_at_entry))
+            if tf_iter > 0 and hasattr(tele, "on_step_program"):
+                n0 = int(min(chunk, tf_iter))
+                if single:
+                    lower = lambda: run1.lower(  # noqa: E731
+                        trainables, opt_state, best, X_f, idx_b,
+                        jnp.asarray(0), n0)
+                else:
+                    lower = lambda: run.lower(  # noqa: E731
+                        trainables, opt_state, alive, best, X_f, thetas,
+                        jnp.asarray(0), n0)
+                tele.on_step_program("factory", lower, n_steps=n0)
+
+        def sync():
+            # restack the single path's squeezed state before it lands
+            # on the (always model-stacked) factory attributes; reads
+            # the CURRENT loop state through the enclosing scope
+            if single:
+                self._sync_state(
+                    _unsqueeze0(trainables),
+                    _unsqueeze_state(opt_state, opt_ref), alive,
+                    (_unsqueeze0(best[0]),
+                     jnp.asarray(best[1]).reshape(1),
+                     jnp.asarray(best[2], jnp.int32).reshape(1)))
+            else:
+                self._sync_state(trainables, opt_state, alive, best)
+
+        result = FitResult()
+        steps_done = 0
+        t0 = _time.time()
+        while steps_done < tf_iter:
+            n = int(min(chunk, tf_iter - steps_done))
+            t_chunk0 = _time.perf_counter()
+            if single:
+                trainables, opt_state, best, comps = run1(
+                    trainables, opt_state, best, X_f, idx_b,
+                    jnp.asarray(steps_done), n)
+            else:
+                trainables, opt_state, alive, best, comps = run(
+                    trainables, opt_state, alive, best, X_f, thetas,
+                    jnp.asarray(steps_done), n)
+            if tele is not None:
+                t_disp = _time.perf_counter() - t_chunk0
+                jax.block_until_ready(comps)
+                t_dev = _time.perf_counter() - t_chunk0 - t_disp
+            comps = jax.tree_util.tree_map(np.asarray, comps)
+            prev_epochs, steps_done = steps_done, steps_done + n
+            if single:
+                # per-row sticky finite sentinel, host-side (the shared
+                # solver runner has no in-scan mask; with one member a
+                # trip means the whole family is dead anyway)
+                comps = {k: v[:, None] for k, v in comps.items()}
+                finite = np.cumprod([
+                    all(np.isfinite(v[e, 0]) for v in comps.values())
+                    and bool(np.asarray(alive)[0])
+                    for e in range(n)]).astype(np.float32)
+                comps["Alive"] = finite[:, None]
+                alive = jnp.asarray([bool(finite[-1])])
+            for e in range(n):
+                self.losses.append({k: v[e] for k, v in comps.items()})
+            alive_rows = comps["Alive"]  # [n, M]
+            newly = 0
+            for m in range(M):
+                if m in self.frozen_at:
+                    continue
+                dead = np.nonzero(alive_rows[:, m] < 0.5)[0]
+                if dead.size:
+                    # global epoch (resumed/second fits offset by the
+                    # history already on record, like every other epoch)
+                    self.frozen_at[m] = (epochs_at_entry + prev_epochs
+                                         + int(dead[0]))
+                    newly += 1
+                    log_event(
+                        "factory", f"member {m} diverged at epoch "
+                        f"{self.frozen_at[m]}: frozen (family trains on)",
+                        verbose=self.verbose, level="warning", member=m,
+                        epoch=self.frozen_at[m])
+            if tele is not None:
+                # n steps, NOT n*M: the cost model priced the whole
+                # family's chunk per STEP (floor and fallback are
+                # already M-scaled), and the step_time histograms keep
+                # the per-step semantics of every other phase
+                tele.on_step_time("factory", n, t_disp, t_dev)
+                last = self.losses[-1]["Total Loss"]
+                pts = M * N * n / max(t_disp + t_dev, 1e-9)
+                tele.on_family_stats(
+                    prev_epochs + n + epochs_at_entry, last,
+                    np.asarray(alive_rows[-1] > 0.5),
+                    newly_frozen=newly, converge_loss=converge_loss,
+                    pts_per_s=pts)
+            if not bool(np.any(alive_rows[-1] > 0.5)):
+                from ..telemetry import TrainingDiverged
+                sync()
+                raise TrainingDiverged(
+                    "factory", prev_epochs + epochs_at_entry,
+                    {"Total Loss": float("nan"),
+                     "members_frozen": float(M)})
+            # -- pipelined per-member redraw (PR 10's double buffer over
+            # the model axis): adopt the previous boundary's dispatch,
+            # then dispatch the next
+            if pending is not None and steps_done >= tf_iter:
+                pending = None  # discard: contract matches fit_adam's
+            if pending is not None:
+                swap, disp_epoch, disp_s = pending
+                pending = None
+                t_sw = _time.perf_counter()
+                X_f = swap.X_new
+                if single:
+                    # squeezed state: the solver's own per-member carry
+                    from types import SimpleNamespace
+
+                    from ..training.fit import _carry_point_state
+                    trainables, opt_state, drift = _carry_point_state(
+                        trainables, opt_state,
+                        SimpleNamespace(idx=swap.idx[0],
+                                        kept=swap.kept[0]), N)
+                else:
+                    trainables, opt_state, drift = \
+                        self._carry_family_state(trainables, opt_state,
+                                                 swap)
+                self.X_f = X_f
+                stats = {k: float(np.mean(np.asarray(v)))
+                         for k, v in swap.stats.items()}
+                stall = _time.perf_counter() - t_sw
+                if tele is not None and hasattr(tele, "on_resample"):
+                    if drift is not None:
+                        stats["lambda_drift"] = float(drift)
+                    # global epochs, like every other factory event — a
+                    # consumer correlating resample events with
+                    # family_stats/frozen_at must see one epoch frame
+                    tele.on_resample("factory",
+                                     epochs_at_entry + steps_done,
+                                     disp_s + stall, stats=stats,
+                                     pipelined=True,
+                                     dispatched_epoch=(epochs_at_entry
+                                                       + disp_epoch),
+                                     flops=(res_flops["v"]
+                                            or (None, None)))
+            if (sampler is not None and steps_done < tf_iter
+                    and prev_epochs // resample_every
+                    != steps_done // resample_every):
+                p_stacked = (_unsqueeze0(trainables["params"]) if single
+                             else trainables["params"])
+                if tele is not None:
+                    # price BEFORE the stall timer (one-off ms-scale
+                    # lowering) and credit the dispatched redraw's FLOPs
+                    # to the chunk it executes behind — fit_adam's rule
+                    fl = resample_flops(p_stacked, X_f, thetas)
+                    if hasattr(tele, "note_resample_flops"):
+                        tele.note_resample_flops(fl[0])
+                t_d0 = _time.perf_counter()
+                # global-epoch key: a second fit() (or a restored
+                # resume) must explore NEW pools, not replay the first
+                # fit's draws — the _DeviceResampleHook epoch_offset
+                # rule on the model axis
+                swap_next = sampler.redraw(p_stacked, X_f, thetas,
+                                           epochs_at_entry + steps_done)
+                pending = (swap_next, steps_done,
+                           _time.perf_counter() - t_d0)
+            if (checkpoint_dir is not None and checkpoint_every > 0
+                    and prev_epochs // checkpoint_every
+                    != steps_done // checkpoint_every):
+                sync()
+                self.save_checkpoint(checkpoint_dir)
+                if tele is not None:
+                    tele.on_checkpoint("factory",
+                                       steps_done + epochs_at_entry)
+        jax.block_until_ready(trainables)
+        result.wall_time["factory"] = _time.time() - t0
+        sync()
+        if tele is not None:
+            losses = self.member_losses()
+            tele.on_fit_end(dict(
+                epochs_total=len(self.losses), n_members=M,
+                members_frozen=len(self.frozen_at),
+                min_loss={"factory": float(np.nanmin(losses))
+                          if np.isfinite(losses).any() else float("nan")},
+                wall_adam=result.wall_time["factory"]))
+        return self
+
+    def _sync_state(self, trainables, opt_state, alive, best):
+        self.params = trainables["params"]
+        self.lambdas = trainables["lambdas"]
+        self.opt_state = opt_state
+        self.alive = alive
+        self.best = best
+
+    # ------------------------------------------------------------------ #
+    def _carry_family_state(self, trainables, opt_state, swap):
+        """Per-member λ-carry through a family redraw: per-point residual
+        λ rows gather through each member's ``swap.idx`` lane, λ-ascent
+        Adam moments follow with fresh rows at zero — the solver's
+        :func:`~tensordiffeq_tpu.training.fit._carry_lambda_rows` walker
+        with the family (vmapped) leaf carry plugged in, so the
+        path/shape guard logic lives in exactly one place."""
+        from ..ops.resampling import carry_rows_family
+        from ..training.fit import _carry_lambda_rows
+
+        M, N = self.n_members, int(self.X_f.shape[1])
+
+        def _is_rows(a):
+            return (a is not None and getattr(a, "ndim", 0) >= 2
+                    and int(a.shape[0]) == M and int(a.shape[1]) == N)
+
+        def carry(a, fresh_zero):
+            new, d = carry_rows_family(a, swap.idx, swap.kept,
+                                       fresh_zero=fresh_zero)
+            return new, jnp.max(d)
+
+        return _carry_lambda_rows(trainables, opt_state, _is_rows, carry)
+
+    # ------------------------------------------------------------------ #
+    def member_losses(self) -> np.ndarray:
+        """``[M]`` latest per-member total losses (NaN for frozen members
+        whose trip epoch predates the last row)."""
+        if not self.losses:
+            return np.full((self.n_members,), np.nan)
+        return np.asarray(self.losses[-1]["Total Loss"], np.float64)
+
+    def member_history(self, m: int) -> list:
+        """Member ``m``'s loss history as the solver's per-epoch dict
+        rows (the solo-comparison view of the stacked history)."""
+        return [{k: float(v[m]) for k, v in row.items()
+                 if k not in ("Alive",)}
+                for row in self.losses]
+
+    def member_params(self, m: int, best: bool = False):
+        """Member ``m``'s parameter pytree (host-sliced off the stack);
+        ``best=True`` returns its best iterate seen during training."""
+        src = self.best[0] if (best and self.best is not None) \
+            else self.params
+        return jax.tree_util.tree_map(lambda a: jnp.asarray(a[m]), src)
+
+    def member_f_model(self, m: int) -> Callable:
+        """Member ``m``'s residual with its concrete θ bound — the
+        ``f_model(u, *coords)`` the member's fleet artifact re-attaches."""
+        theta = self.member_thetas[m]
+        f = self.f_model
+        return lambda u, *coords: f(u, *coords, theta)
+
+    def member_surrogate(self, m: int, best: bool = False):
+        """Member ``m`` as a deployable
+        :class:`~tensordiffeq_tpu.serving.Surrogate` (inference-only:
+        params + net + the member's bound residual)."""
+        from ..serving import Surrogate
+        return Surrogate(self.net, self.member_params(m, best=best),
+                         self.varnames, n_out=self.n_out,
+                         f_model=self.member_f_model(m),
+                         contract="forward")
+
+    # ------------------------------------------------------------------ #
+    def export_family(self, path: str, *, min_bucket: int = 256,
+                      max_bucket: int = 4096, kinds=None,
+                      best: bool = False, aot: bool = True,
+                      registry=None) -> dict:
+        """Slice every LIVE member into a v2 AOT fleet artifact under
+        ``path/member_<m>`` (:func:`~tensordiffeq_tpu.fleet.
+        export_fleet_artifact`) and write ``family_manifest.json`` —
+        the artifact *batch* :meth:`~tensordiffeq_tpu.fleet.FleetRouter.
+        register_family` loads directly.  Frozen (diverged) members are
+        skipped and recorded in the manifest instead of shipping a
+        poisoned surrogate.  ``registry`` receives the
+        ``factory.exports`` counter (default: the process registry —
+        pass the run's registry to keep all ``factory.*`` instruments
+        in one snapshot).  Returns the manifest dict."""
+        from ..fleet import export_fleet_artifact
+        from ..telemetry import default_registry
+
+        kw = {"min_bucket": min_bucket, "max_bucket": max_bucket,
+              "aot": aot}
+        if kinds is not None:
+            kw["kinds"] = kinds
+        os.makedirs(path, exist_ok=True)
+        alive = np.asarray(self.alive)
+        members, frozen = {}, {}
+        for m in range(self.n_members):
+            if not bool(alive[m]):
+                frozen[str(m)] = int(self.frozen_at.get(m, -1))
+                continue
+            rel = f"member_{m:03d}"
+            export_fleet_artifact(self.member_surrogate(m, best=best),
+                                  os.path.join(path, rel), **kw)
+            members[str(m)] = rel
+        manifest = {
+            "format": 1,
+            "n_members": self.n_members,
+            "members": members,
+            "frozen": frozen,
+            "thetas": [[np.asarray(x).tolist()
+                        for x in jax.tree_util.tree_leaves(t)]
+                       for t in self.member_thetas],
+            "layer_sizes": list(self.layer_sizes),
+            "varnames": list(self.varnames),
+        }
+        with open(os.path.join(path, FAMILY_MANIFEST), "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        registry = registry if registry is not None else default_registry()
+        registry.counter("factory.exports").inc(len(members))
+        log_event("factory", f"exported {len(members)} member artifact(s) "
+                  f"-> {path}" + (f" ({len(frozen)} frozen member(s) "
+                                  "skipped)" if frozen else ""),
+                  verbose=self.verbose, path=str(path),
+                  members=len(members), frozen=len(frozen))
+        return manifest
+
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, path: str, sharded: Optional[bool] = None):
+        """Checkpoint the FULL family training state — stacked params, λ,
+        Adam moments, per-member collocation sets, θ, the alive mask —
+        through the topology-portable checkpoint backend.  The model
+        axis is just another sharded leaf dimension: a ``dist=8`` family
+        checkpoint restores onto a ``dist=4`` factory (and back), the
+        same 8→4 contract the elastic trainer holds."""
+        from ..checkpoint import save_checkpoint
+        state = {"params": self.params, "lambdas": self.lambdas,
+                 "X_f": self.X_f, "thetas": self.thetas,
+                 "alive": jnp.asarray(self.alive, jnp.float32)}
+        if self.opt_state is not None:
+            state["opt_state"] = self.opt_state
+        if self.best is not None:
+            state["best_params"] = self.best[0]
+            state["best_loss"] = self.best[1]
+            state["best_step"] = jnp.asarray(self.best[2], jnp.float32)
+        meta = {"n_members": self.n_members,
+                "epochs": len(self.losses),
+                "losses": [{k: np.asarray(v).tolist()
+                            for k, v in row.items()}
+                           for row in self.losses],
+                "frozen_at": {str(k): int(v)
+                              for k, v in self.frozen_at.items()},
+                "has_opt_state": self.opt_state is not None,
+                "has_best": self.best is not None}
+        save_checkpoint(path, state, meta, sharded=sharded)
+        log_event("checkpoint", f"saved family state -> {path}",
+                  verbose=False, path=str(path), members=self.n_members,
+                  epochs=len(self.losses))
+
+    def restore_checkpoint(self, path: str):
+        """Restore a family checkpoint into this factory.  The restore
+        is where elastic re-sharding happens: the per-shard manifest
+        reassembles global host arrays and THIS factory's mesh re-shards
+        them — an 8-device checkpoint resumes on 4 devices."""
+        import json as _json
+
+        from ..checkpoint import resolve_checkpoint_dir, restore_checkpoint
+        with open(os.path.join(resolve_checkpoint_dir(path),
+                               "tdq_meta.json")) as fh:
+            meta_peek = _json.load(fh)["meta"]
+        if int(meta_peek.get("n_members", -1)) != self.n_members:
+            raise ValueError(
+                f"checkpoint has {meta_peek.get('n_members')} members but "
+                f"this factory was built with {self.n_members}; the "
+                "family axis is part of the configuration")
+
+        def host(tree):
+            return jax.tree_util.tree_map(
+                lambda a: None if a is None else np.zeros(
+                    np.shape(a), np.dtype(a.dtype)),
+                tree, is_leaf=lambda x: x is None)
+
+        template = {"params": host(self.params),
+                    "lambdas": host(self.lambdas),
+                    "X_f": np.zeros(self.X_f.shape, np.float32),
+                    "thetas": host(self.thetas),
+                    "alive": np.zeros((self.n_members,), np.float32)}
+        if meta_peek.get("has_opt_state", False):
+            opt = make_optimizer(self.lr, self.lr_weights)
+            template["opt_state"] = host(opt.init(
+                {"params": host(self.params),
+                 "lambdas": host(self.lambdas)}))
+        if meta_peek.get("has_best", False):
+            template["best_params"] = host(self.params)
+            template["best_loss"] = np.zeros((self.n_members,), np.float32)
+            template["best_step"] = np.zeros((self.n_members,), np.float32)
+        state, meta = restore_checkpoint(path, template)
+        # θ is configuration, like n_members: the member coefficients
+        # feed BOTH the traced training step (self.thetas) and the
+        # concrete export/serving bindings (self.member_thetas) — a
+        # checkpoint trained under different coefficients restored here
+        # would silently export artifacts whose residual programs carry
+        # a θ the params were never trained for
+        for mine, saved in zip(jax.tree_util.tree_leaves(self.thetas),
+                               jax.tree_util.tree_leaves(state["thetas"])):
+            if not np.array_equal(np.asarray(mine), np.asarray(saved)):
+                raise ValueError(
+                    "checkpoint was trained with different member "
+                    "coefficients (thetas) than this factory was built "
+                    "with; the family axis is part of the configuration")
+        self.params = state["params"]
+        self.lambdas = state["lambdas"]
+        self.X_f = jnp.asarray(np.asarray(state["X_f"]), jnp.float32)
+        self.thetas = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a)), state["thetas"])
+        self.alive = jnp.asarray(np.asarray(state["alive"]) > 0.5)
+        self.opt_state = state.get("opt_state")
+        self.best = None
+        if "best_params" in state:
+            self.best = (state["best_params"],
+                         jnp.asarray(np.asarray(state["best_loss"])),
+                         jnp.asarray(np.asarray(state["best_step"]),
+                                     jnp.int32))
+        self.losses = [{k: np.asarray(v, np.float32)
+                        for k, v in row.items()}
+                       for row in meta.get("losses", [])]
+        self.frozen_at = {int(k): int(v)
+                          for k, v in meta.get("frozen_at", {}).items()}
+        if self._mesh is not None:
+            self._place_family()
+        else:
+            self.params = jax.tree_util.tree_map(jnp.asarray, self.params)
+            self.lambdas = jax.tree_util.tree_map(
+                lambda a: None if a is None else jnp.asarray(a),
+                self.lambdas, is_leaf=lambda x: x is None)
+        log_event("restore", f"restored family state from {path} "
+                  f"({len(self.losses)} epochs, "
+                  f"{len(self.frozen_at)} frozen)", verbose=False,
+                  path=str(path), epochs=len(self.losses))
+        return self

@@ -268,6 +268,41 @@ class FleetRouter:
             max_queue_points=self._registered[tenant].policy.max_queue_points,
             priority=self._registered[tenant].policy.priority)
 
+    def register_family(self, path: str, *,
+                        policy: Optional[TenantPolicy] = None,
+                        prefix: Optional[str] = None,
+                        f_models: Optional[dict] = None) -> dict:
+        """Register every member of a surrogate-factory artifact batch
+        (:meth:`~tensordiffeq_tpu.factory.SurrogateFactory.
+        export_family`): reads ``family_manifest.json`` under ``path``
+        and registers each live member's v2 AOT artifact as a tenant —
+        the factory's product loads directly into the fleet.  Frozen
+        (diverged) members recorded in the manifest are skipped; member
+        AOT artifacts serve residual queries with no ``f_model``
+        re-attached (the exported program embeds the computation), but
+        ``f_models`` — ``{member_index: f_model}`` with the member's θ
+        already bound — re-attaches user code where the jit fallback
+        path needs it.  Returns ``{member_index: tenant_name}`` keyed
+        by the ORIGINAL member index (mirroring the manifest), never a
+        positional sequence: with a frozen member skipped, positions
+        would silently shift every later member onto the wrong
+        coefficient."""
+        import json as _json
+        import os as _os
+
+        from ..factory import FAMILY_MANIFEST
+        with open(_os.path.join(path, FAMILY_MANIFEST)) as fh:
+            manifest = _json.load(fh)
+        names = {}
+        for m, rel in sorted(manifest["members"].items(),
+                             key=lambda kv: int(kv[0])):
+            tenant = rel if prefix is None else f"{prefix}{int(m):03d}"
+            self.register(
+                tenant, _os.path.join(path, rel),
+                f_model=(f_models or {}).get(int(m)), policy=policy)
+            names[int(m)] = tenant
+        return names
+
     def tenants(self) -> tuple:
         return tuple(self._registered)
 

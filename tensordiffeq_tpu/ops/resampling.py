@@ -271,24 +271,8 @@ class DeviceResampler:
                                              self.xlimits))
         pool = self._place(jnp.concatenate([X_cur, fresh], axis=0))
         f = self.residual_fn(params, pool)
-        parts = f if isinstance(f, tuple) else (f,)
-        scores = None
-        for part in parts:
-            a = jnp.abs(jnp.asarray(part, jnp.float32))
-            a = jnp.sum(a.reshape(a.shape[0], -1), axis=1)
-            scores = a if scores is None else scores + a
-        idx = _gumbel_topk_device(scores, self.n_f, self.temp,
-                                  self.uniform_frac, k_sel)
-        X_new = self._place(jnp.take(pool, idx, axis=0))
-        kept = idx < self.n_f
-        sel_mean = jnp.mean(jnp.take(scores, idx))
-        pool_mean = jnp.mean(scores)
-        stats = {
-            "kept_fraction": jnp.mean(kept.astype(jnp.float32)),
-            "score_gain": sel_mean / jnp.maximum(
-                pool_mean, jnp.finfo(jnp.float32).tiny),
-        }
-        return ResampleSwap(X_new, idx, kept, stats)
+        return _score_and_select(pool, f, self.n_f, self.temp,
+                                 self.uniform_frac, k_sel, self.placement)
 
     def redraw(self, params, X_cur, epoch: int) -> ResampleSwap:
         """Dispatch one redraw (async — returns device futures)."""
@@ -298,6 +282,98 @@ class DeviceResampler:
         """The redraw program's ``Lowered`` (cost analysis without a
         compile) — the score-pass FLOP pricing hook."""
         return self._redraw_jit.lower(params, X_cur, jnp.asarray(0))
+
+
+def _score_and_select(pool, f, n_f: int, temp: float, uniform_frac: float,
+                      k_sel, placement) -> "ResampleSwap":
+    """The one score→select→stats block every device redraw shares
+    (:class:`DeviceResampler` and :class:`FamilyResampler` per member):
+    |residual| summed over components/columns, Gumbel top-k under the
+    importance distribution, kept mask (pool index < ``n_f`` means a
+    kept current point) and the kept_fraction / score_gain diagnostics.
+    One implementation so a future scoring fix (the PR-10 ``log(0)``
+    clamp class) cannot drift between the redraw flavors."""
+    parts = f if isinstance(f, tuple) else (f,)
+    scores = None
+    for part in parts:
+        a = jnp.abs(jnp.asarray(part, jnp.float32))
+        a = jnp.sum(a.reshape(a.shape[0], -1), axis=1)
+        scores = a if scores is None else scores + a
+    idx = _gumbel_topk_device(scores, n_f, temp, uniform_frac, k_sel)
+    X_new = jnp.take(pool, idx, axis=0)
+    if placement is not None:
+        X_new = jax.lax.with_sharding_constraint(X_new, placement)
+    kept = idx < n_f
+    sel_mean = jnp.mean(jnp.take(scores, idx))
+    pool_mean = jnp.mean(scores)
+    stats = {
+        "kept_fraction": jnp.mean(kept.astype(jnp.float32)),
+        "score_gain": sel_mean / jnp.maximum(
+            pool_mean, jnp.finfo(jnp.float32).tiny),
+    }
+    return ResampleSwap(X_new, idx, kept, stats)
+
+
+class FamilyResampler:
+    """:class:`DeviceResampler` batched over a surrogate-factory MODEL
+    axis: per-member pool → score → select as ONE jitted program for the
+    whole family (``jax.vmap`` over members), so a 64-member family's
+    redraw costs one dispatch, exactly like its training step.
+
+    ``residual_fn(params_m, X_m, theta_m)`` is the per-member residual
+    with the family parameter θ as a traced operand — the factory's
+    member engine.  Each member draws an independent stratified fresh
+    pool (keys decorrelated via ``fold_in(fold_in(seed, epoch),
+    member)``), scores ``[its current X_f ; fresh]``, and Gumbel-top-k
+    selects its own ``n_f`` points; kept rows carry that member's
+    per-point λ through :func:`carry_rows_family`.  The returned
+    :class:`ResampleSwap` is stacked: ``X_new [M, n_f, d]``, ``idx`` /
+    ``kept`` ``[M, n_f]``, stats ``[M]`` per member.  Calling
+    :meth:`redraw` only dispatches (async) — the factory double-buffers
+    it behind the next training chunk, the PR 10 pipeline over the model
+    axis."""
+
+    pipelined = True
+
+    def __init__(self, residual_fn: Callable, xlimits: np.ndarray,
+                 n_f: int, n_members: int, *, pool_factor: int = 4,
+                 temp: float = 1.0, uniform_frac: float = 0.1,
+                 seed: int = 0):
+        self.residual_fn = residual_fn
+        # tdq: allow[dtype-discipline] domain limits held in f64 on the HOST; the jitted pool draw casts per-dim bounds to f32 scalars
+        self.xlimits = np.asarray(xlimits, np.float64)
+        self.n_f = int(n_f)
+        self.n_members = int(n_members)
+        self.temp = float(temp)
+        self.uniform_frac = float(uniform_frac)
+        self.seed = int(seed)
+        self.n_fresh = max(int(pool_factor) - 1, 1) * self.n_f
+        self._redraw_jit = jax.jit(self._redraw_impl)
+
+    def _member_redraw(self, params, X_cur, theta, key):
+        k_pool, k_sel = jax.random.split(key)
+        fresh = _stratified_pool(k_pool, self.n_fresh, self.xlimits)
+        pool = jnp.concatenate([X_cur, fresh], axis=0)
+        f = self.residual_fn(params, pool, theta)
+        return _score_and_select(pool, f, self.n_f, self.temp,
+                                 self.uniform_frac, k_sel, None)
+
+    def _redraw_impl(self, params, X_cur, thetas, epoch):
+        base = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        keys = jax.vmap(lambda m: jax.random.fold_in(base, m))(
+            jnp.arange(self.n_members))
+        return jax.vmap(self._member_redraw)(params, X_cur, thetas, keys)
+
+    def redraw(self, params, X_cur, thetas, epoch: int) -> ResampleSwap:
+        """Dispatch one family redraw (async — returns device futures,
+        stacked along the model axis)."""
+        return self._redraw_jit(params, X_cur, thetas,
+                                jnp.asarray(int(epoch)))
+
+    def lower_redraw(self, params, X_cur, thetas):
+        """The family redraw's ``Lowered`` (cost analysis, no compile)."""
+        return self._redraw_jit.lower(params, X_cur, thetas,
+                                      jnp.asarray(0))
 
 
 def _carry_impl(rows, idx, kept, fresh_zero: bool, placement):
@@ -343,6 +419,24 @@ def carry_rows(rows, idx, kept, fresh_zero: bool = False):
     if placement is None or getattr(placement, "mesh", None) is None:
         placement = None
     return _carry_jit(rows, idx, kept, fresh_zero, placement)
+
+
+def _carry_family_impl(rows, idx, kept, fresh_zero: bool):
+    return jax.vmap(
+        lambda r, i, k: _carry_impl(r, i, k, fresh_zero, None))(
+            rows, idx, kept)
+
+
+_carry_family_jit = jax.jit(_carry_family_impl,
+                            static_argnames=("fresh_zero",))
+
+
+def carry_rows_family(rows, idx, kept, fresh_zero: bool = False):
+    """:func:`carry_rows` batched over the surrogate-factory model axis:
+    ``rows [M, n_f, ...]`` row-aligned with each member's OLD collocation
+    set, gathered through that member's ``idx [M, n_f]`` lane.  Returns
+    ``(new_rows, drift)`` with ``drift [M]`` per member."""
+    return _carry_family_jit(rows, idx, kept, fresh_zero)
 
 
 def gather_rows_multihost(X_global) -> np.ndarray:

@@ -280,6 +280,46 @@ class TrainingTelemetry:
                            phase=phase, epoch=epoch,
                            pipelined=bool(pipelined), **stats)
 
+    def on_family_stats(self, epoch: int, losses, alive,
+                        newly_frozen: int = 0,
+                        converge_loss: Optional[float] = None,
+                        pts_per_s: Optional[float] = None):
+        """One surrogate-factory chunk's family summary
+        (:class:`~tensordiffeq_tpu.factory.SurrogateFactory`):
+        per-member loss quantiles over the LIVE members, frozen /
+        converged member gauges, and the aggregate family throughput —
+        the ``factory.*`` instruments (docs/metrics.md).  ``losses`` and
+        ``alive`` are the ``[M]`` per-member latest losses and alive
+        mask; ``newly_frozen`` counts members the divergence mask froze
+        this chunk; ``converge_loss`` arms the converged gauge."""
+        losses = np.asarray(losses, np.float64)
+        alive = np.asarray(alive, bool)
+        m = int(losses.shape[0])
+        reg = self.registry
+        reg.gauge("factory.members").set(m)
+        reg.gauge("factory.members_frozen").set(int((~alive).sum()))
+        if newly_frozen:
+            reg.counter("factory.divergences").inc(int(newly_frozen))
+        live = losses[alive & np.isfinite(losses)]
+        qs = {}
+        if live.size:
+            # single-sourced percentile semantics (profiling.py)
+            qs = percentiles(live, qs=(10, 50, 90))
+            for q, v in qs.items():
+                reg.gauge("factory.loss_quantile", q=q).set(v)
+        converged = None
+        if converge_loss is not None:
+            converged = int((live <= float(converge_loss)).sum())
+            reg.gauge("factory.members_converged").set(converged)
+        if pts_per_s is not None:
+            reg.gauge("factory.pts_per_s").set(float(pts_per_s))
+        self.event("family_stats", epoch=int(epoch), members=m,
+                   frozen=int((~alive).sum()),
+                   newly_frozen=int(newly_frozen), converged=converged,
+                   loss_quantiles=qs,
+                   pts_per_s=(None if pts_per_s is None
+                              else float(pts_per_s)))
+
     def on_lambda_stats(self, epoch: int, lambdas: dict):
         stats = lambda_summaries(lambdas)
         if stats:

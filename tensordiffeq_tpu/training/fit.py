@@ -293,29 +293,23 @@ def _chunk_runner(loss_fn: Callable, opt: optax.GradientTransformation,
     return run
 
 
-def _carry_point_state(trainables, opt_state, swap, n_points: int):
-    """Carry per-point SA state through a :class:`~tensordiffeq_tpu.ops.
-    resampling.DeviceResampler` redraw: per-point residual λ rows gather
-    through ``swap.idx`` (kept rows ride, fresh rows initialize from the
-    adaptive schedule — see :func:`..ops.resampling.carry_rows`), and the
-    λ-ascent Adam moments follow the same map with fresh rows restarting
-    at zero (a fresh point has no ascent history).  Only leaves on the
-    ``lambdas/residual`` path with a leading ``n_points`` axis are
-    touched — the moment remap walks the optimizer state by PATH, so a
-    BC λ (or a network layer) whose size coincides with ``n_points`` is
-    never mis-carried.  Returns ``(trainables, opt_state, drift)`` with
-    ``drift`` None when no per-point λ exist (nothing to carry)."""
-    from ..ops.resampling import carry_rows
-
-    def _is_rows(a):
-        return (a is not None and getattr(a, "ndim", 0) >= 1
-                and int(a.shape[0]) == n_points)
-
+def _carry_lambda_rows(trainables, opt_state, is_rows, carry):
+    """The ONE λ-carry walker every resample flavor shares (the solver's
+    per-point path and the factory's per-member family path): residual λ
+    terms matching ``is_rows`` are remapped through ``carry(leaf,
+    fresh_zero)`` (kept rows ride, fresh rows initialize per the
+    adaptive schedule / at zero for moments), and the λ-ascent Adam
+    moments follow the same map — walked by PATH on the optimizer
+    state's ``lam`` branch, so a BC λ (or a network layer) whose size
+    coincides with the row count is never mis-carried.  Returns
+    ``(trainables, opt_state, drift)`` with ``drift`` None when no
+    matching λ exist (nothing to carry).  One implementation so a
+    future fix to the path/shape guards applies to every flavor."""
     drift = None
     new_terms = []
     for lam in trainables["lambdas"]["residual"]:
-        if _is_rows(lam):
-            lam, d = carry_rows(lam, swap.idx, swap.kept)
+        if is_rows(lam):
+            lam, d = carry(lam, False)
             drift = d if drift is None else jnp.maximum(drift, d)
         new_terms.append(lam)
     if drift is None:
@@ -328,8 +322,8 @@ def _carry_point_state(trainables, opt_state, swap, n_points: int):
         return any(getattr(k, "key", None) == "residual" for k in path)
 
     def remap(path, a):
-        if _on_residual_path(path) and _is_rows(a):
-            return carry_rows(a, swap.idx, swap.kept, fresh_zero=True)[0]
+        if _on_residual_path(path) and is_rows(a):
+            return carry(a, True)[0]
         return a
 
     inner = getattr(opt_state, "inner_states", None)
@@ -339,6 +333,26 @@ def _carry_point_state(trainables, opt_state, swap, n_points: int):
             remap, inner["lam"])
         opt_state = opt_state._replace(inner_states=new_inner)
     return trainables, opt_state, drift
+
+
+def _carry_point_state(trainables, opt_state, swap, n_points: int):
+    """Carry per-point SA state through a :class:`~tensordiffeq_tpu.ops.
+    resampling.DeviceResampler` redraw: per-point residual λ rows gather
+    through ``swap.idx`` (kept rows ride, fresh rows initialize from the
+    adaptive schedule — see :func:`..ops.resampling.carry_rows`), and the
+    λ-ascent Adam moments follow the same map with fresh rows restarting
+    at zero (a fresh point has no ascent history).  The walking/guard
+    logic lives in :func:`_carry_lambda_rows`."""
+    from ..ops.resampling import carry_rows
+
+    def _is_rows(a):
+        return (a is not None and getattr(a, "ndim", 0) >= 1
+                and int(a.shape[0]) == n_points)
+
+    def carry(a, fresh_zero):
+        return carry_rows(a, swap.idx, swap.kept, fresh_zero=fresh_zero)
+
+    return _carry_lambda_rows(trainables, opt_state, _is_rows, carry)
 
 
 def _adopt_points(X_new, X_f, batch_sz, mesh, best):

@@ -24,7 +24,14 @@ TOP_LEVEL = ["CollocationSolverND", "DiscoveryModel", "DomainND",
              "find_L2_error", "MSE", "g_MSE",
              # fleet/serving deployment surface (PR 6)
              "FleetRouter", "TenantPolicy", "AdmissionController",
-             "AdmissionRejected", "ArtifactVersionMismatch"]
+             "AdmissionRejected", "ArtifactVersionMismatch",
+             # the surrogate factory (PR 15)
+             "SurrogateFactory"]
+
+# the surrogate-factory surface (docs/api.md Factory section, PR 15)
+FACTORY = ["SurrogateFactory", "FAMILY_MANIFEST", "make_family_runner",
+           "member_slice", "stack_members"]
+FACTORY_RESAMPLING = ["FamilyResampler", "carry_rows_family"]
 
 # the fleet package's own public surface (docs/api.md Fleet section)
 FLEET = ["FleetRouter", "TenantPolicy", "LoadedTenant",
@@ -83,7 +90,18 @@ def test_top_level_reexports():
 def test_fleet_surface():
     missing = [f"tdq.fleet.{n}" for n in FLEET
                if not hasattr(tdq.fleet, n)]
+    # the factory's artifact batch loads straight into the router
+    assert hasattr(tdq.fleet.FleetRouter, "register_family")
     assert not missing, f"fleet surface missing: {missing}"
+
+
+def test_factory_surface():
+    from tensordiffeq_tpu.ops import resampling
+    missing = [f"tdq.factory.{n}" for n in FACTORY
+               if not hasattr(tdq.factory, n)]
+    missing += [f"ops.resampling.{n}" for n in FACTORY_RESAMPLING
+                if not hasattr(resampling, n)]
+    assert not missing, f"factory surface missing: {missing}"
 
 
 def test_elastic_surface():

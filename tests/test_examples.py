@@ -83,6 +83,19 @@ def test_ac_fleet_example_runs():
 
 
 @pytest.mark.slow
+def test_ac_factory_example_runs():
+    """The PR-15 acceptance demo: a coefficient-sweep family trained as
+    ONE vmapped program, two members cross-checked against matched-seed
+    solo references within the documented band, the family exported as
+    an artifact batch and fleet-served bit-identically to the members'
+    direct engines (the script itself asserts all of this).  Marked slow
+    for tier-1 wall budget: the same paths run fast in
+    tests/test_factory.py; this adds the full E2E round-trip and the
+    narrated report on top."""
+    run_example("ac_factory.py", "--quick")
+
+
+@pytest.mark.slow
 def test_ac_resilient_example_runs():
     """The PR-5 acceptance demo: ONE supervised run survives a chaos NaN
     divergence and a chaos preemption, the serving leg heals injected

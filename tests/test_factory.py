@@ -1,0 +1,409 @@
+"""The surrogate factory (PR 15): vmapped many-model training.
+
+The correctness anchor is the degenerate family: a chaos-off 1-member
+factory fit is BIT-IDENTICAL to the plain ``CollocationSolverND`` fit
+(same seed, same config) — the factory reuses the solver's own compiled
+chunk runner for M == 1, so the subsystem's state plumbing (λ stacking,
+optimizer wiring, history, checkpointing) adds exactly nothing.  The
+vmapped M > 1 path is held to the engine-adoption band instead (vmap's
+batched transposes reorder matmul accumulation) and to per-lane
+bit-isolation: a NaN member freezes without perturbing its neighbors.
+"""
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC,
+                              SurrogateFactory, dirichletBC, grad)
+
+N_F = 256
+LAYERS = [2, 12, 12, 1]
+
+
+def make_domain():
+    d = DomainND(["x", "t"], time_var="t")
+    d.add("x", [-1.0, 1.0], 32)
+    d.add("t", [0.0, 1.0], 8)
+    d.generate_collocation_points(N_F, seed=0)
+    return d
+
+
+def make_bcs(d):
+    return [IC(d, [lambda x: x ** 2 * np.cos(np.pi * x)], var=[["x"]]),
+            dirichletBC(d, val=0.0, var="x", target="upper"),
+            dirichletBC(d, val=0.0, var="x", target="lower")]
+
+
+def f_model_fam(u, x, t, th):
+    return grad(u, "t")(x, t) - th * grad(grad(u, "x"), "x")(x, t) \
+        + 5.0 * u(x, t) ** 3 - 5.0 * u(x, t)
+
+
+SA_KW = dict(
+    Adaptive_type=1,
+    dict_adaptive={"residual": [True], "BCs": [False] * 3},
+    init_weights={"residual": [np.ones((N_F, 1))], "BCs": [None] * 3})
+
+
+def make_factory(thetas, layers=None, dist=False, sa=True, seed=0,
+                 fused=None):
+    d = make_domain()
+    kw = dict(SA_KW) if sa else {}
+    return SurrogateFactory(layers or LAYERS, f_model_fam, d, make_bcs(d),
+                            thetas=thetas, dist=dist, seed=seed,
+                            fused=fused, verbose=False, **kw)
+
+
+def leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(la, lb))
+
+
+@pytest.fixture(scope="module")
+def family_fit():
+    """One M=2 trained family shared by the read-only tests (module
+    scope: tier-1 wall discipline)."""
+    fac = make_factory([0.001, 0.01])
+    fac.fit(tf_iter=20, chunk=10)
+    return fac
+
+
+# --------------------------------------------------------------------- #
+# the correctness anchor
+# --------------------------------------------------------------------- #
+def test_one_member_family_bit_identical_to_plain_solver():
+    """Chaos-off 1-member factory fit == plain CollocationSolverND fit,
+    bit for bit: params, per-point λ, and the loss history."""
+    fac = make_factory([0.001])
+    fac.fit(tf_iter=30, chunk=10)
+
+    d = make_domain()
+    solver = CollocationSolverND(verbose=False, seed=0)
+    solver.compile(LAYERS, lambda u, x, t: f_model_fam(u, x, t, 0.001),
+                   d, make_bcs(d), **SA_KW)
+    solver.fit(tf_iter=30, chunk=10)
+
+    assert leaves_equal(fac.member_params(0), solver.params)
+    lam_f = np.asarray(fac.lambdas["residual"][0][0])
+    lam_s = np.asarray(solver.lambdas["residual"][0])
+    assert lam_f.tobytes() == lam_s.tobytes()
+    hist_f = [float(r["Total Loss"][0]) for r in fac.losses]
+    hist_s = [r["Total Loss"] for r in solver.losses]
+    assert hist_f == hist_s
+
+
+def test_family_engine_matches_template_adoption(family_fit):
+    """The family vmaps the engine the template solver adopted — for
+    this AC-type problem on CPU that is the fused minimax step."""
+    assert family_fit.engine == "fused-minimax"
+    assert family_fit.n_members == 2
+
+
+def test_family_members_track_solo_references():
+    """Each member of an M=2 family stays within the engine-adoption
+    band of its matched-seed solo solver over a short budget (vmap's
+    batched transposes reorder accumulation; the trajectories drift in
+    ulps, not in dynamics)."""
+    fac = make_factory([0.001, 0.01], sa=False)
+    fac.fit(tf_iter=20, chunk=10)
+    for m, th in enumerate([0.001, 0.01]):
+        d = make_domain()
+        solver = CollocationSolverND(verbose=False, seed=m)
+        solver.compile(LAYERS, lambda u, x, t, _t=th: f_model_fam(
+            u, x, t, _t), d, make_bcs(d))
+        solver.fit(tf_iter=20, chunk=10)
+        hist_m = np.array([float(r["Total Loss"][m]) for r in fac.losses])
+        hist_s = np.array([r["Total Loss"] for r in solver.losses])
+        np.testing.assert_allclose(hist_m, hist_s, rtol=1e-3, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# divergence masking
+# --------------------------------------------------------------------- #
+def test_nan_member_is_frozen_and_cannot_poison_the_family():
+    """Poison member 1's params with NaN: the divergence mask freezes it
+    at epoch 0 (reported in frozen_at), while member 0's trajectory is
+    BIT-IDENTICAL to the unpoisoned family's — vmap lanes are
+    independent, and the factory keeps them that way."""
+    facA = make_factory([0.001, 0.01])
+    facB = make_factory([0.001, 0.01])
+    facB.params = jax.tree_util.tree_map(
+        lambda a: a.at[1].set(jnp.nan), facB.params)
+    facA.fit(tf_iter=10, chunk=5)
+    facB.fit(tf_iter=10, chunk=5)
+
+    assert np.asarray(facB.alive).tolist() == [True, False]
+    assert facB.frozen_at == {1: 0}
+    for a, b in zip(jax.tree_util.tree_leaves(facA.params),
+                    jax.tree_util.tree_leaves(facB.params)):
+        assert np.asarray(a[0]).tobytes() == np.asarray(b[0]).tobytes()
+    lamA = np.asarray(facA.lambdas["residual"][0][0])
+    lamB = np.asarray(facB.lambdas["residual"][0][0])
+    assert lamA.tobytes() == lamB.tobytes()
+
+
+def test_frozen_at_records_global_epoch_across_fits():
+    """Review-round regression: a member that diverges in a SECOND fit
+    call records its global trip epoch (prior history counted), matching
+    the loss-history indexing and the manifest record."""
+    fac = make_factory([0.001, 0.01])
+    fac.fit(tf_iter=4, chunk=2)
+    fac.params = jax.tree_util.tree_map(
+        lambda a: a.at[1].set(jnp.nan), fac.params)
+    fac.fit(tf_iter=4, chunk=2)
+    assert fac.frozen_at == {1: 4}  # global epoch, not fit-relative 0
+
+
+def test_all_members_frozen_raises_training_diverged():
+    from tensordiffeq_tpu.telemetry import TrainingDiverged
+    fac = make_factory([0.001, 0.01])
+    fac.params = jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.nan), fac.params)
+    with pytest.raises(TrainingDiverged):
+        fac.fit(tf_iter=10, chunk=5)
+    assert not np.asarray(fac.alive).any()
+
+
+def test_frozen_members_are_skipped_by_export(tmp_path):
+    fac = make_factory([0.001, 0.01, 0.02])
+    fac.params = jax.tree_util.tree_map(
+        lambda a: a.at[1].set(jnp.nan), fac.params)
+    fac.fit(tf_iter=4, chunk=2)
+    man = fac.export_family(str(tmp_path / "fam"), min_bucket=32,
+                            max_bucket=32, aot=False)
+    assert list(man["members"]) == ["0", "2"]
+    assert man["frozen"] == {"1": 0}
+    # register_family keys by ORIGINAL index across the gap: member 2
+    # stays member 2 (a positional tuple would serve it as "member 1")
+    from tensordiffeq_tpu.fleet import FleetRouter
+    names = FleetRouter(max_loaded=2).register_family(
+        str(tmp_path / "fam"))
+    assert names == {0: "member_000", 2: "member_002"}
+
+
+# --------------------------------------------------------------------- #
+# per-member adaptive collocation
+# --------------------------------------------------------------------- #
+def test_family_resample_diverges_member_point_sets_and_carries_lambda():
+    """Per-member redraw: members end up with DIFFERENT collocation
+    sets (independent pools + residual landscapes), shapes/λ preserved,
+    per-member λ carried finite through the swap — and the redraw's
+    score pass is PRICED (resample.score_flops emitted, credited to the
+    overlapped chunk: the PR-10 accounting on the model axis)."""
+    from tensordiffeq_tpu.telemetry import (MetricsRegistry,
+                                            TrainingTelemetry)
+    reg = MetricsRegistry()
+    fac = make_factory([0.001, 0.01])
+    X0 = np.asarray(fac.X_f)
+    fac.fit(tf_iter=20, chunk=5, resample_every=5,
+            telemetry=TrainingTelemetry(registry=reg))
+    X1 = np.asarray(fac.X_f)
+    assert X1.shape == X0.shape
+    assert not np.array_equal(X1[0], X0[0])  # member 0 redrew
+    assert not np.array_equal(X1[0], X1[1])  # members diverged
+    lam = np.asarray(fac.lambdas["residual"][0])
+    assert lam.shape[:2] == (2, N_F) and np.isfinite(lam).all()
+    assert np.isfinite(fac.member_losses()).all()
+    d = reg.as_dict()
+    assert d["counters"]["resample.redraws"] >= 1
+    assert d["gauges"]["resample.score_flops"] > 0  # review-round pin
+    # the degenerate 1-member family resamples through the solver's own
+    # carry path and stays finite
+    fac1 = make_factory([0.001])
+    fac1.fit(tf_iter=10, chunk=5, resample_every=5)
+    assert np.isfinite(fac1.member_losses()).all()
+
+
+def test_family_redraw_keys_advance_across_fits(monkeypatch):
+    """Review-round regression: a second fit() (or a restored resume)
+    dispatches redraws at GLOBAL epochs, so its pool/selection keys —
+    fold_in(seed, epoch) — never replay the first fit's draws (the
+    _DeviceResampleHook epoch_offset rule on the model axis)."""
+    from tensordiffeq_tpu.ops import resampling
+    seen = []
+    orig = resampling.FamilyResampler.redraw
+
+    def spy(self, params, X, thetas, epoch):
+        seen.append(int(epoch))
+        return orig(self, params, X, thetas, epoch)
+
+    monkeypatch.setattr(resampling.FamilyResampler, "redraw", spy)
+    from tensordiffeq_tpu.telemetry import (MetricsRegistry,
+                                            TrainingTelemetry)
+    swaps = []
+
+    class Tele(TrainingTelemetry):
+        def on_resample(self, phase, epoch, *a, **kw):
+            swaps.append((int(epoch), int(kw["dispatched_epoch"])))
+            super().on_resample(phase, epoch, *a, **kw)
+
+    tele = Tele(registry=MetricsRegistry())
+    fac = make_factory([0.001, 0.01])
+    # tf_iter=15 so each fit both dispatches AND adopts one redraw (a
+    # dispatch at the final boundary is discarded by contract)
+    fac.fit(tf_iter=15, chunk=5, resample_every=5, telemetry=tele)
+    fac.fit(tf_iter=15, chunk=5, resample_every=5, telemetry=tele)
+    # dispatch keys: global epochs — the second fit offset by the 15
+    # prior epochs, never replaying the first fit's draws
+    assert seen == [5, 10, 20, 25]
+    # resample events report the same GLOBAL epoch frame as every other
+    # factory event (review-round pin): (swap epoch, dispatched epoch)
+    assert swaps == [(10, 5), (25, 20)]
+
+
+# --------------------------------------------------------------------- #
+# checkpoint: the model axis is just another sharded leaf
+# --------------------------------------------------------------------- #
+def test_family_checkpoint_roundtrip(family_fit, tmp_path):
+    family_fit.save_checkpoint(str(tmp_path / "ck"))
+    fac2 = make_factory([0.001, 0.01])
+    fac2.restore_checkpoint(str(tmp_path / "ck"))
+    assert leaves_equal(family_fit.params, fac2.params)
+    assert leaves_equal(family_fit.lambdas, fac2.lambdas)
+    assert len(fac2.losses) == len(family_fit.losses)
+    # resumed training proceeds (moments restored)
+    fac2.fit(tf_iter=4, chunk=2)
+    assert np.isfinite(fac2.member_losses()).all()
+
+
+def test_family_checkpoint_reshard_8_to_4(eight_devices, tmp_path):
+    """The elastic contract on the model axis: an 8-device family
+    checkpoint restores onto a 4-device mesh — state bit-exact through
+    the re-shard, resumed trajectory matching the uninterrupted 8-device
+    run at the PR-8 re-shard band (GSPMD partitions the per-member
+    reductions differently per topology, so cross-topology equality is
+    rtol-level, not bitwise)."""
+    thetas = [0.001 * (m + 1) for m in range(8)]
+    # generic engine (fused=False): the re-shard contract is about the
+    # checkpoint layout and mesh placement, not the loss engine — and
+    # skipping the template's fused/minimax adoption cross-checks keeps
+    # this test's tier-1 wall small
+    fac8 = make_factory(thetas, layers=[2, 10, 1], dist=8, sa=False,
+                        fused=False)
+    fac8.fit(tf_iter=8, chunk=4)
+    fac8.save_checkpoint(str(tmp_path / "ck"), sharded=True)
+    saved_params = jax.tree_util.tree_map(np.asarray, fac8.params)
+    fac8.fit(tf_iter=8, chunk=4)
+
+    fac4 = make_factory(thetas, layers=[2, 10, 1], dist=4, sa=False,
+                        fused=False)
+    fac4.restore_checkpoint(str(tmp_path / "ck"))
+    # state survives the re-shard bit-exactly
+    assert leaves_equal(saved_params, fac4.params)
+    fac4.fit(tf_iter=8, chunk=4)
+    h8 = np.stack([r["Total Loss"] for r in fac8.losses])
+    h4 = np.stack([r["Total Loss"] for r in fac4.losses])
+    np.testing.assert_allclose(h4, h8, rtol=1e-4, atol=1e-7)
+
+
+def test_member_count_mismatch_rejected(family_fit, tmp_path):
+    family_fit.save_checkpoint(str(tmp_path / "ck"))
+    fac3 = make_factory([0.001, 0.01, 0.02])
+    with pytest.raises(ValueError, match="members"):
+        fac3.restore_checkpoint(str(tmp_path / "ck"))
+    # review-round pin: same M but DIFFERENT coefficients is rejected
+    # too — restored params trained under other θ would silently export
+    # artifacts whose residual programs carry the wrong coefficient
+    fac_other = make_factory([0.005, 0.05])
+    with pytest.raises(ValueError, match="coefficients"):
+        fac_other.restore_checkpoint(str(tmp_path / "ck"))
+
+
+# --------------------------------------------------------------------- #
+# the artifact batch -> fleet
+# --------------------------------------------------------------------- #
+def test_export_family_serves_through_fleet_bit_identically(family_fit,
+                                                            tmp_path):
+    """The acceptance pin: a factory-trained member's exported artifact
+    serves through FleetRouter bit-identically to the member's own
+    direct surrogate engine — and the AOT artifact answers residual
+    queries with no f_model re-attached."""
+    from tensordiffeq_tpu.fleet import FleetRouter, TenantPolicy
+    fam = str(tmp_path / "fam")
+    man = family_fit.export_family(fam, min_bucket=32, max_bucket=64)
+    assert sorted(man["members"]) == ["0", "1"]
+
+    router = FleetRouter(max_loaded=4)
+    names = router.register_family(
+        fam, policy=TenantPolicy(min_bucket=32, max_bucket=64))
+    # keyed by ORIGINAL member index, so a frozen member can never
+    # shift later members onto the wrong coefficient (review-round pin)
+    assert names == {0: "member_000", 1: "member_001"}
+    Xq = np.random.RandomState(0).uniform(
+        -1, 1, (16, 2)).astype(np.float32)
+    for m, name in names.items():
+        served = np.asarray(router.query(name, Xq))
+        direct = np.asarray(family_fit.member_surrogate(m).engine(
+            min_bucket=32, max_bucket=64).u(Xq))
+        assert np.array_equal(served, direct)
+    # residual kind through the embedded AOT program (no f_model)
+    res = np.asarray(router.query(names[0], Xq, kind="residual"))
+    assert res.shape == (16,) and np.isfinite(res).all()
+
+
+# --------------------------------------------------------------------- #
+# telemetry: the factory.* instruments
+# --------------------------------------------------------------------- #
+def test_family_fit_emits_factory_instruments(tmp_path):
+    from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger,
+                                            TrainingTelemetry)
+    logger = RunLogger(str(tmp_path / "run"),
+                       registry=MetricsRegistry())
+    step_time_calls = []
+
+    class Tele(TrainingTelemetry):
+        def on_step_time(self, phase, n_steps, *a, **kw):
+            step_time_calls.append(n_steps)
+            super().on_step_time(phase, n_steps, *a, **kw)
+
+    tele = Tele(logger=logger)
+    fac = make_factory([0.001, 0.01])
+    fac.params = jax.tree_util.tree_map(
+        lambda a: a.at[1].set(jnp.nan), fac.params)
+    fac.fit(tf_iter=6, chunk=3, telemetry=tele, converge_loss=1e9)
+    # review-round regression: FAMILY steps, not member-steps — the cost
+    # model priced the whole family's chunk per step, so n*M here would
+    # inflate cost.mfu by M
+    assert step_time_calls == [3, 3]
+    fac.export_family(str(tmp_path / "fam"), min_bucket=32,
+                      max_bucket=32, aot=False,
+                      registry=logger.registry)
+    g = logger.registry.as_dict()["gauges"]
+    c = logger.registry.as_dict()["counters"]
+    # review-round regression: the exports counter lands in the SAME
+    # registry as the other factory.* instruments when one is passed
+    assert c["factory.exports"] == 1  # the live member
+    assert g["factory.members"] == 2
+    assert g["factory.members_frozen"] == 1
+    assert g["factory.members_converged"] == 1  # the live member
+    assert g["factory.pts_per_s"] > 0
+    assert any(k.startswith("factory.loss_quantile") for k in g)
+    assert c["factory.divergences"] == 1
+    # the vmapped step is priced (family-exact floor: cost.* gauges live)
+    assert any(k.startswith("cost.flops_per_step") for k in g)
+    logger.close()
+    from tensordiffeq_tpu.telemetry import read_events
+    kinds = {e["kind"] for e in read_events(str(tmp_path / "run"))}
+    assert "family_stats" in kinds
+
+
+def test_validation_errors():
+    d = make_domain()
+    with pytest.raises(ValueError, match="at least one"):
+        SurrogateFactory(LAYERS, f_model_fam, d, make_bcs(d), thetas=[],
+                         verbose=False)
+    with pytest.raises(ValueError, match="NTK"):
+        SurrogateFactory(LAYERS, f_model_fam, d, make_bcs(d),
+                         thetas=[0.1], Adaptive_type=3, verbose=False)
+    with pytest.raises(ValueError, match="divide evenly"):
+        SurrogateFactory([2, 8, 1], f_model_fam, d, make_bcs(d),
+                         thetas=[0.1, 0.2, 0.3], dist=2, verbose=False)

@@ -68,11 +68,14 @@ def test_every_suppression_carries_a_reason_and_is_used(full_run):
 
 def test_jaxpr_audit_pins_zero_host_hops_in_hot_programs():
     """The acceptance pin: zero device->host transfers and zero host
-    callbacks inside the fused minimax step and the device resampler
-    (plus the serving kind programs) — a checked property now, not a
-    PERF.md claim."""
+    callbacks inside the fused minimax step, the device resampler, and
+    the surrogate factory's vmapped family step (plus the serving kind
+    programs) — a checked property now, not a PERF.md claim.  "One
+    program per family step" (PR 15) is judged here like its PR 12
+    siblings."""
     from tensordiffeq_tpu.analysis.jaxpr_audit import HOT_PROGRAMS, audit
-    assert {"fused-minimax-step", "device-resampler"} <= set(HOT_PROGRAMS)
+    assert {"fused-minimax-step", "device-resampler",
+            "vmapped-factory-step"} <= set(HOT_PROGRAMS)
     for name in HOT_PROGRAMS:
         report = audit(name)
         assert report.ok, f"{name}: {report.summary()}"

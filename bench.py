@@ -260,6 +260,49 @@ def build_solver(n_f, nx, nt, widths, seed=0, fused=None, dtype=_UNSET,
     return solver
 
 
+def build_system_solver(n_f, nx, nt, widths, seed=0, minimax=None):
+    """A coupled 2-equation Schrödinger-type system (the classical
+    2-output PINN benchmark shape) at the bench domain sizes, with
+    per-point SA λ on BOTH residual channels — the multi-component arm of
+    ``--mode minimax``: it exercises the widened ``[N, E]`` fused unit
+    (one λ/weight channel per equation) end to end."""
+    from tensordiffeq_tpu import CollocationSolverND, DomainND, IC, grad, periodicBC
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], nx)
+    domain.add("t", [0.0, 1.0], nt)
+    domain.generate_collocation_points(n_f, seed=seed)
+
+    ics = IC(domain,
+             [lambda x: x ** 2 * np.cos(np.pi * x), lambda x: 0.0 * x],
+             var=[["x"], ["x"]])
+
+    def deriv_model(u, x, t):
+        return (u[0](x, t), u[1](x, t),
+                grad(u[0], "x")(x, t), grad(u[1], "x")(x, t))
+
+    bcs = [ics, periodicBC(domain, ["x"], [deriv_model])]
+
+    def f_model(u, x, t):
+        uv, vv = u[0](x, t), u[1](x, t)
+        sq = uv ** 2 + vv ** 2
+        f_u = grad(u[0], "t")(x, t) \
+            + 0.5 * grad(grad(u[1], "x"), "x")(x, t) + sq * vv
+        f_v = grad(u[1], "t")(x, t) \
+            - 0.5 * grad(grad(u[0], "x"), "x")(x, t) - sq * uv
+        return f_u, f_v
+
+    rng = np.random.RandomState(seed)
+    solver = CollocationSolverND(verbose=False)
+    solver.compile(
+        [2, *widths, 2], f_model, domain, bcs, Adaptive_type=1,
+        dict_adaptive={"residual": [True, True], "BCs": [True, False]},
+        init_weights={"residual": [rng.rand(n_f, 1), rng.rand(n_f, 1)],
+                      "BCs": [100.0 * rng.rand(nx, 1), None]},
+        fused=True, minimax=minimax)
+    return solver
+
+
 def make_sa_step(solver):
     import jax
     import optax
@@ -774,15 +817,27 @@ def bench_minimax(n_f, nx, nt, widths, n_steps):
     bar is a measured step-time reduction there: the fusion owns its data
     layout, so the batched channel matmul's pathological AD transpose is
     replaced by the flat-GEMM custom VJP); on real TPU the engine lowers
-    to the VMEM-resident pallas kernel and each arm quotes its own MFU."""
+    to the VMEM-resident pallas kernel and each arm quotes its own MFU.
+
+    A second pair of arms (``system``/``system-unfused``) races the SAME
+    comparison on a coupled 2-equation Schrödinger-type system with
+    per-point λ on both channels — the widened ``[N, E]`` fused unit vs
+    two generic per-equation residual terms (the multi-component
+    acceptance read: fused step-time reduction ≥1.1× at drift ~0)."""
     import jax
 
     n_chips = 1  # single-device solvers: per-chip == measured
     arms = {}
-    for name, minimax in (("unfused", False), ("minimax", True)):
+    for name, minimax in (("unfused", False), ("minimax", True),
+                          ("system-unfused", False), ("system", True)):
+        system = name.startswith("system")
         try:
-            solver = build_solver(n_f, nx, nt, widths, fused=True,
-                                  minimax=minimax)
+            if system:
+                solver = build_system_solver(n_f, nx, nt, widths,
+                                             minimax=minimax)
+            else:
+                solver = build_solver(n_f, nx, nt, widths, fused=True,
+                                      minimax=minimax)
             t0 = time.time()
             step, trainables, opt_state = aot_compile_sa_step(solver)
             flops_per_step = compiled_flops(step)
@@ -822,7 +877,12 @@ def bench_minimax(n_f, nx, nt, widths, n_steps):
         raise RuntimeError(f"minimax arm failed: {arms}")
     speedup = (round(un["step_time_s"] / mm["step_time_s"], 3)
                if "step_time_s" in un else None)
-    return {
+
+    def _rounded(arm):
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in arm.items()}
+
+    payload = {
         "metric": ("AC-SA step time: fused-minimax vs unfused fused-XLA "
                    f"(engine: {mm['engine']})"),
         "value": round(mm["pts_per_sec"]),
@@ -830,13 +890,27 @@ def bench_minimax(n_f, nx, nt, widths, n_steps):
         # the acceptance read: unfused step time / minimax step time
         "vs_baseline": speedup,
         "step_time_reduction": speedup,
-        "minimax": {k: (round(v, 6) if isinstance(v, float) else v)
-                    for k, v in mm.items()},
-        "unfused": {k: (round(v, 6) if isinstance(v, float) else v)
-                    for k, v in un.items()},
+        "minimax": _rounded(mm),
+        "unfused": _rounded(un),
         "loss_drift": (abs(mm["loss"] - un["loss"])
                        if "loss" in mm and "loss" in un else None),
     }
+    smm, sun = arms.get("system", {}), arms.get("system-unfused", {})
+    if "pts_per_sec" in smm:
+        # the coupled 2-equation arm: same read on the widened [N, E] unit
+        payload["system"] = {
+            "n_equations": 2,
+            "step_time_reduction": (
+                round(sun["step_time_s"] / smm["step_time_s"], 3)
+                if "step_time_s" in sun else None),
+            "loss_drift": (abs(smm["loss"] - sun["loss"])
+                           if "loss" in smm and "loss" in sun else None),
+            "fused": _rounded(smm),
+            "unfused": _rounded(sun),
+        }
+    elif smm or sun:
+        payload["system"] = {"error": smm.get("error") or sun.get("error")}
+    return payload
 
 
 # --------------------------------------------------------------------------- #
@@ -1652,7 +1726,13 @@ def bench_resample(n_f, widths, adam_iter, newton_iter, resample_every,
       path (``resample_device=False``: numpy pool, scores pulled to
       host, synchronous),
     * ``adaptive-device``  — the device-resident redraw, pipelined
-      behind the training chunks (the default path).
+      behind the training chunks (the default path),
+    * ``pacmann``          — the gradient-ascent mover
+      (``resample_mode="ascent"``, arXiv:2411.19632): retained points
+      climb the residual-magnitude landscape instead of being redrawn
+      from a pool; same pipelined one-program contract as the device
+      path, scored through the fused step's own ∂/∂X cotangent when the
+      minimax engine is adopted.
 
     Two headline reads: (1) *steps-to-rel-L2-gate* — the cumulative
     optimizer step (Adam epochs + L-BFGS iterations) of the first
@@ -1728,7 +1808,8 @@ def bench_resample(n_f, widths, adam_iter, newton_iter, resample_every,
             arm["stall_s"] = {k: round(float(stall[k]), 5)
                               for k in ("mean", "p50", "p99", "max")
                               if stall.get(k) is not None}
-            for g in ("resample.kept_fraction", "resample.score_gain"):
+            for g in ("resample.kept_fraction", "resample.score_gain",
+                      "resample.ascent_steps"):
                 if g in snap["gauges"]:
                     arm[g.split(".", 1)[1]] = round(snap["gauges"][g], 4)
         arms[name] = arm
@@ -1743,6 +1824,13 @@ def bench_resample(n_f, widths, adam_iter, newton_iter, resample_every,
             resample_device=False, resample_seed=1)
     run_arm("adaptive-device", resample_every=resample_every,
             resample_seed=1)
+    # ascent knobs measured on this config: 3 steps at the default
+    # step_frac resolve the shock ridge without overshooting it, and the
+    # 0.3 coverage floor keeps the moved set from collapsing onto it
+    # (fresh 0.1 final-l2'd 6x worse; step_frac 0.02 never gated)
+    run_arm("pacmann", resample_every=resample_every,
+            resample_seed=1, resample_mode="ascent",
+            resample_ascent_steps=3, resample_uniform=0.3)
     return resample_payload(arms, gate=gate, n_f=n_f,
                             budget=adam_iter + newton_iter,
                             resample_every=resample_every)
@@ -1756,13 +1844,17 @@ def resample_payload(arms, gate, n_f, budget, resample_every):
     never reached the gate inside the budget lower-bounds the speedup
     (disclosed in ``note``); an adaptive arm that never reached it
     reports ``value: null`` rather than impersonating a win.  The
-    redraw-stall split (``redraw_stall_*``) compares the two adaptive
-    arms' steady-state (p50) per-redraw host-visible stall."""
+    redraw-stall split (``redraw_stall_*``) compares the adaptive arms'
+    steady-state (p50) per-redraw host-visible stall.  The ``pacmann``
+    (ascent-mover) arm adds a third read: its steps-to-gate against the
+    pool→top-k device arm (``pacmann_vs_pool`` ≤ 1 means the mover
+    reaches the bar in no more steps than the redraw)."""
     if not arms:
         return None
     payload = {
         "metric": f"Burgers steps-to-rel-L2<={gate:g}: fixed LHS vs "
-                  "adaptive vs adaptive+device-pipelined redraw "
+                  "adaptive vs adaptive+device-pipelined redraw vs "
+                  "PACMANN ascent mover "
                   f"(N_f={n_f}, {budget} Adam+L-BFGS steps, "
                   f"resample_every={resample_every})",
         "value": None, "unit": "x fewer steps to rel-L2 gate",
@@ -1771,7 +1863,8 @@ def resample_payload(arms, gate, n_f, budget, resample_every):
     fixed = arms.get("fixed")
     dev = arms.get("adaptive-device")
     host = arms.get("adaptive-host")
-    if len(arms) < 3:
+    pac = arms.get("pacmann")
+    if len(arms) < 4:
         payload["partial"] = (f"only {sorted(arms)} completed; "
                               "arms missing from this line died or are "
                               "still running")
@@ -1789,15 +1882,29 @@ def resample_payload(arms, gate, n_f, budget, resample_every):
                     "optimizer steps; speedup quoted against the full "
                     "budget is a lower bound")
             payload["vs_baseline"] = payload["value"]
+    if pac is not None:
+        e_pac = pac["epochs_to_gate"]
+        if e_pac is not None and fixed is not None:
+            e_fix = fixed["epochs_to_gate"]
+            payload["pacmann_vs_fixed"] = (
+                round(e_fix / e_pac, 3) if e_fix is not None
+                else round(budget / e_pac, 3))
+        if (e_pac is not None and dev is not None
+                and dev["epochs_to_gate"] is not None):
+            # ≤ 1 means the ascent mover needs no more steps than the
+            # pool→top-k redraw at the same cadence/budget
+            payload["pacmann_vs_pool"] = round(
+                e_pac / dev["epochs_to_gate"], 3)
     stalls = {n: a["stall_s"] for n, a in
-              (("host", host), ("device", dev))
+              (("host", host), ("device", dev), ("pacmann", pac))
               if a is not None and "stall_s" in a}
     if stalls:
         payload["redraw_stall_s_p50"] = {n: s["p50"]
                                          for n, s in stalls.items()}
         payload["redraw_stall_s_mean"] = {n: s["mean"]
                                           for n, s in stalls.items()}
-        if len(stalls) == 2 and stalls["device"]["p50"] > 0:
+        if ("host" in stalls and "device" in stalls
+                and stalls["device"]["p50"] > 0):
             payload["redraw_stall_reduction"] = round(
                 stalls["host"]["p50"] / stalls["device"]["p50"], 2)
     return payload

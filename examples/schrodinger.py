@@ -7,6 +7,14 @@ The network has TWO outputs — h = u + iv — exercising the coupled-system
 surface the reference supports (tuple residuals + per-output ICs,
 ``models.py:189-191``) but ships no example of.  Truth: the split-step
 Fourier spectral solution in ``tensordiffeq_tpu.exact``.
+
+Since PR 16 the tuple-returning ``f_model`` adopts the fused minimax
+engine as a TWO-equation system (watch for ``[fuse] minimax engine
+adopted`` at compile): both residuals, their per-equation λ channels,
+and every cotangent reduce in one fusion (``ops/pallas_minimax``), so
+the coupled benchmark trains on the same fast path as the scalar
+examples — the measured step-time reduction is in ``bench.py --mode
+minimax`` (``system`` block) and a convergence row in CONVERGENCE.md.
 """
 
 import numpy as np

@@ -17,8 +17,16 @@ costs milliseconds and needs no XLA compile (the same
   bias cotangents + the per-point ∂loss/∂w (λ-ascent direction) + the
   point cotangent (PR 9's 2.36× win; one stray ``float(tracer)`` here
   and the whole fusion falls apart).
+* ``fused-minimax-system-step`` — the E-equation widening of the same
+  unit (PR 16): a coupled 2-component residual with the ``[N, E]``
+  per-equation weight block; systems must ride the fast path without
+  re-introducing a host hop.
 * ``device-resampler`` — PR 10's one-program pool→score→select redraw
   (the 163ms→1.8ms stall win is exactly "no host round-trip here").
+* ``ascent-resampler`` — the PACMANN gradient-ascent redraw (PR 16):
+  K clipped moves up the residual landscape + fresh replacement, one
+  program; it differentiates w.r.t. the points inside the redraw, a
+  natural place for a stray host fetch.
 * ``serving-u`` / ``serving-residual`` — the engine's per-kind bucket
   programs (the fleet's zero-request-time-compile path).
 * ``vmapped-factory-step`` — the surrogate factory's family chunk
@@ -165,6 +173,44 @@ def _minimax_program():
     return step, (layers, w, X)
 
 
+def _minimax_system_program():
+    """The E-equation widened fused step (PR 16): a coupled 2-component
+    f_model through the same value-plus-every-cotangent unit, with the
+    ``[N, E]`` per-equation weight block.  The widening must not cost the
+    fusion its host-hop-free property — the whole point of lifting
+    systems onto the fast path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.derivatives import grad
+    from ..ops.fused import analyze_f_model
+    from ..ops.pallas_minimax import build_minimax_sq_fn
+    from ..ops.taylor import extract_mlp_layers
+
+    net, params = _micro_net(seed=4, n_out=2)
+    layers = extract_mlp_layers(params)
+    shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
+
+    def f_model(u, x, t):  # Schrödinger-type coupled pair
+        f_u = grad(u[0], "t")(x, t) + 0.5 * grad(grad(u[1], "x"), "x")(x, t)
+        f_v = grad(u[1], "t")(x, t) - 0.5 * grad(grad(u[0], "x"), "x")(x, t)
+        return f_u, f_v
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 2)
+    sq = build_minimax_sq_fn(f_model, ("x", "t"), 2, reqs, shapes)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(16, 2) * 0.5, jnp.float32)
+    w = jnp.asarray(rng.rand(16, 2), jnp.float32)
+
+    def step(layers, w, X):
+        val, vjp = jax.vjp(sq, layers, w, X)
+        g_layers, g_w, g_X = vjp(jnp.ones((), val.dtype))
+        return val, g_layers, g_w, g_X
+
+    return step, (layers, w, X)
+
+
 def _resampler_program():
     """PR 10's one-program pool->score->select redraw."""
     import jax.numpy as jnp
@@ -179,6 +225,28 @@ def _resampler_program():
 
     xlimits = np.array([[-1.0, 1.0], [0.0, 1.0]])
     r = DeviceResampler(residual_fn, xlimits, n_f=16, pool_factor=2)
+    X = jnp.zeros((16, 2), jnp.float32)
+    return r._redraw_impl, (params, X, jnp.asarray(0))
+
+
+def _ascent_resampler_program():
+    """The PACMANN ascent redraw (PR 16): K clipped gradient-ascent
+    moves + lowest-score fresh replacement as one program.  The mover
+    differentiates the residual w.r.t. the POINTS inside the redraw — a
+    natural place for a stray host fetch to creep in."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.resampling import AscentResampler
+
+    net, params = _micro_net(seed=5)
+
+    def residual_fn(params, X):
+        return net.apply(params, X)
+
+    xlimits = np.array([[-1.0, 1.0], [0.0, 1.0]])
+    r = AscentResampler(residual_fn, xlimits, n_f=16, n_steps=2,
+                        fresh_frac=0.25)
     X = jnp.zeros((16, 2), jnp.float32)
     return r._redraw_impl, (params, X, jnp.asarray(0))
 
@@ -269,7 +337,9 @@ def _factory_program():
 
 HOT_PROGRAMS = {
     "fused-minimax-step": _minimax_program,
+    "fused-minimax-system-step": _minimax_system_program,
     "device-resampler": _resampler_program,
+    "ascent-resampler": _ascent_resampler_program,
     "serving-u": _serving_program("u"),
     "serving-residual": _serving_program("residual"),
     "vmapped-factory-step": _factory_program,

@@ -522,7 +522,8 @@ class SurrogateFactory:
     def fit(self, tf_iter: int, chunk: int = 100,
             resample_every: int = 0, resample_pool: int = 4,
             resample_temp: float = 1.0, resample_uniform: float = 0.1,
-            resample_seed: int = 0,
+            resample_seed: int = 0, resample_mode: str = "pool",
+            resample_ascent_steps: int = 5,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0,
             telemetry=None, converge_loss: Optional[float] = None):
@@ -535,7 +536,14 @@ class SurrogateFactory:
         (:class:`~tensordiffeq_tpu.ops.resampling.FamilyResampler`),
         double-buffered behind the training chunks (dispatch at the due
         boundary, swap at the next); per-member λ and λ-ascent moments
-        carry through each member's redraw.
+        carry through each member's redraw.  ``resample_mode="ascent"``
+        swaps in the PACMANN mover batched over the model axis
+        (:class:`~tensordiffeq_tpu.ops.resampling.FamilyAscentResampler`):
+        each member's points take ``resample_ascent_steps``
+        gradient-ascent steps up that member's own residual landscape,
+        with a stratified ``resample_uniform``×N_f coverage draw
+        replacing the lowest-score rows (``resample_pool`` /
+        ``resample_temp`` are pool-path knobs, ignored here).
 
         ``telemetry``: a :class:`~tensordiffeq_tpu.telemetry.
         TrainingTelemetry` (or bare RunLogger).  The family emits the
@@ -618,11 +626,22 @@ class SurrogateFactory:
         pending = None
         res_flops = {"v": None}
         if resample_every > 0:
-            from ..ops.resampling import FamilyResampler
-            sampler = FamilyResampler(
-                self._member_residual, self.domain.xlimits, N, M,
-                pool_factor=resample_pool, temp=resample_temp,
-                uniform_frac=resample_uniform, seed=resample_seed)
+            if resample_mode == "ascent":
+                from ..ops.resampling import FamilyAscentResampler
+                sampler = FamilyAscentResampler(
+                    self._member_residual, self.domain.xlimits, N, M,
+                    n_steps=resample_ascent_steps,
+                    fresh_frac=resample_uniform, seed=resample_seed)
+            elif resample_mode == "pool":
+                from ..ops.resampling import FamilyResampler
+                sampler = FamilyResampler(
+                    self._member_residual, self.domain.xlimits, N, M,
+                    pool_factor=resample_pool, temp=resample_temp,
+                    uniform_frac=resample_uniform, seed=resample_seed)
+            else:
+                raise ValueError(
+                    f"resample_mode={resample_mode!r}: expected 'pool' or "
+                    "'ascent'")
 
         def resample_flops(p_stacked, X, th):
             """``(flops, basis)`` of one family redraw — credited to the
@@ -656,7 +675,9 @@ class SurrogateFactory:
                 tele.cost_fallback = (
                     M * analytic_minimax_flops(
                         self.layer_sizes, N,
-                        n_channels(self._template._fuse_requests)),
+                        n_channels(self._template._fuse_requests),
+                        n_equations=getattr(self._template,
+                                            "_minimax_n_eq", 1)),
                     "analytic-minimax")
             tele.on_fit_start(dict(
                 tf_iter=tf_iter, n_members=M, N_f=N,

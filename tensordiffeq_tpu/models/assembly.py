@@ -88,8 +88,11 @@ def build_loss_fn(apply_fn: Callable,
         ``(params, lam_res, X) -> scalar`` replacing the whole
         residual-evaluation + λ-weighting + reduction block with one fused
         unit (the minimax engine,
-        :mod:`tensordiffeq_tpu.ops.pallas_minimax` — single-component
-        residuals, the λ semantics of this function reproduced inside).
+        :mod:`tensordiffeq_tpu.ops.pallas_minimax` — the per-term λ list
+        routes one channel per residual equation, this function's λ
+        semantics reproduced per channel inside the fusion; an E-equation
+        system reports as a single ``Residual_0`` component equal to the
+        Σ over the generic engine's per-equation terms).
         Takes precedence over ``residual_fn`` for the residual term;
         incompatible with ``causal_eps`` (cross-point bin weighting cannot
         live inside the per-point fusion) — the solver gates on that.
@@ -188,8 +191,8 @@ def build_loss_fn(apply_fn: Callable,
 
         if residual_loss_fn is not None:
             # the fused minimax unit: residual + λ weighting + reduction
-            # (and, under AD, every cotangent) in one fusion — single
-            # residual component by construction
+            # (and, under AD, every cotangent) in one fusion — the whole
+            # system residual (Σ over equations) reports as one component
             loss_res = residual_loss_fn(params, lam_res, X_batch)
             components["Residual_0"] = loss_res
             f_preds = ()

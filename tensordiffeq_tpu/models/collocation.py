@@ -206,12 +206,15 @@ class CollocationSolverND:
             (:mod:`..ops.pallas_minimax`).  ``None`` (default)
             auto-adopts, for the training loss, the fused unit that
             computes residual + SA-λ-weighted loss + parameter cotangents
-            + the per-point λ-ascent direction in one fusion (the
-            VMEM-resident pallas kernel on real TPU, the fused-XLA jaxpr
-            elsewhere) whenever the residual qualifies (fused engine
-            active, single residual component, no ``causal_eps``, no
-            ``remat``) AND it passes the same numeric cross-check gate as
-            the fused residual; silently falls back otherwise.  ``False``
+            + the per-point, per-equation λ-ascent directions in one
+            fusion (the VMEM-resident pallas kernel on real TPU, the
+            fused-XLA jaxpr elsewhere) whenever the residual qualifies
+            (fused engine active, single-column residual equations — a
+            tuple-returning ``f_model`` adopts as an E-equation system
+            with one λ/weight channel per component — no ``causal_eps``,
+            no ``remat``) AND it passes the same numeric cross-check gate
+            as the fused residual, run on the real (multi-component)
+            collocation set; silently falls back otherwise.  ``False``
             forces the unfused loss; ``True`` requires the minimax engine
             and raises with the disqualifying reason.
           ntk_max_ratio: bound on the NTK weights' dynamic range
@@ -639,13 +642,12 @@ class CollocationSolverND:
                     "remat wraps the residual evaluation; the fused "
                     "minimax loss already owns its memory layout")
             reqs = self._fuse_requests
-            # raises for multi-component residuals (systems)
-            ncols = pmm.residual_columns(self.f_model, self.domain.vars,
-                                         self.n_out, reqs)
-            if ncols != 1:
-                raise ValueError(
-                    f"residual has {ncols} output columns; per-point λ "
-                    "weighting is defined for scalar residuals")
+            # E single-column equations (1 = the scalar family; a tuple-
+            # returning f_model is an E-equation system, each component
+            # getting its own λ/weight channel).  Raises for layouts the
+            # per-point fusion cannot serve (multi-column components).
+            n_eq = pmm.residual_columns(self.f_model, self.domain.vars,
+                                        self.n_out, reqs)
             # pallas flavor only on real TPU hardware: interpret mode is a
             # test vehicle, not a training engine (the XLA fallback is the
             # CPU fast path — and what the interpret kernel is pinned
@@ -687,6 +689,8 @@ class CollocationSolverND:
                                       "unfused": t_un * 1e3})
             self._minimax_loss = mm
             self._minimax_kind = "pallas" if use_pallas else "xla"
+            self._minimax_sq = sq        # the ascent resampler's free-∂X hook
+            self._minimax_n_eq = n_eq    # E: widened cost basis + w sizing
             self._minimax_loss_refine = mm
             if self.fused_dtype is not None:
                 # full-precision flavor for L-BFGS retreat (same engine,
@@ -711,6 +715,31 @@ class CollocationSolverND:
             log_event("fuse", f"minimax engine not adopted "
                       f"({type(e).__name__}: {e}); keeping the unfused "
                       "loss", verbose=self.verbose)
+
+    def _minimax_score_grad_fn(self):
+        """``score_grad(params, X) -> (scores [N], gX [N, d])`` for the
+        PACMANN ascent resampler, built from the adopted fused minimax
+        unit: ONE ``jax.vjp`` of ``sq(layers, 1, X)`` yields the
+        per-point scores (the ``∂/∂w`` cotangent IS ``f_{e,p}²`` —
+        summed over equations) AND ``∂/∂X``, the ascent direction — no
+        differentiation beyond what the training step already fuses.
+        ``None`` when the fused engine is not adopted (the resampler
+        then falls back to ``value_and_grad`` over the compiled
+        residual)."""
+        sq = getattr(self, "_minimax_sq", None)
+        if sq is None:
+            return None
+        n_eq = int(getattr(sq, "n_equations", 1))
+        from ..ops.taylor import extract_mlp_layers
+
+        def score_grad(params, X):
+            layers = extract_mlp_layers(params)
+            w = jnp.ones((X.shape[0], n_eq), X.dtype)
+            val, vjp = jax.vjp(sq, layers, w, X)
+            _, gw, gx = vjp(jnp.ones((), val.dtype))
+            return jnp.sum(jnp.reshape(gw, (X.shape[0], -1)), axis=1), gx
+
+        return score_grad
 
     def _time_loss_step(self, residual_fn=None, residual_loss_fn=None,
                         reps: int = 3):
@@ -858,6 +887,8 @@ class CollocationSolverND:
         self._minimax_loss = None
         self._minimax_loss_refine = None
         self._minimax_kind = None
+        self._minimax_sq = None
+        self._minimax_n_eq = 1
         self._minimax_fail_reason = None
         if self.minimax is not False and self._fused_residual is not None \
                 and getattr(self, "_fuse_requests", None) is not None:
@@ -971,6 +1002,8 @@ class CollocationSolverND:
             resample_every: int = 0, resample_pool: int = 4,
             resample_temp: float = 1.0, resample_uniform: float = 0.1,
             resample_seed: int = 0, resample_device: Optional[bool] = None,
+            resample_mode: str = "pool",
+            resample_ascent_steps: int = 5,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0,
             telemetry=None, grad_clip: Optional[float] = None):
@@ -1036,6 +1069,21 @@ class CollocationSolverND:
         kept fraction, score gain, λ drift, host-visible stall) and as a
         ``train.resample`` span.
 
+        ``resample_mode="ascent"`` (device path only) selects the PACMANN
+        mover (arXiv:2411.19632) instead of pool→top-k: the current
+        points take ``resample_ascent_steps`` normalized-gradient steps
+        UP the residual-magnitude landscape (clipped to the domain box),
+        with a stratified fresh draw of ``resample_uniform``×N_f points
+        replacing the lowest-score rows as the coverage floor
+        (``resample_pool``/``resample_temp`` are pool-path knobs and are
+        ignored).  When the fused minimax engine is adopted, the per-point
+        scores and the ascent direction both come from ONE ``jax.vjp`` of
+        the fused ``sq`` unit — ``∂/∂w`` IS ``f²`` per point/equation and
+        ``∂/∂X`` is the move direction — so scoring costs no extra
+        differentiation.  Moved points keep their row, so per-point λ and
+        its ascent moments ride through unchanged; the redraw is the same
+        pipelined, host-hop-free single program as the pool path.
+
         ``telemetry`` (beyond-reference;
         :mod:`tensordiffeq_tpu.telemetry`): a
         :class:`~tensordiffeq_tpu.telemetry.TrainingTelemetry` subscriber
@@ -1078,6 +1126,8 @@ class CollocationSolverND:
                                 resample_uniform=resample_uniform,
                                 resample_seed=resample_seed,
                                 resample_device=resample_device,
+                                resample_mode=resample_mode,
+                                resample_ascent_steps=resample_ascent_steps,
                                 telemetry=telemetry, grad_clip=grad_clip)
         tele = as_training_telemetry(telemetry)
         epochs_at_entry = len(self.losses)
@@ -1104,8 +1154,10 @@ class CollocationSolverND:
                 from ..ops.pallas_minimax import n_channels
                 from ..telemetry.costmodel import analytic_minimax_flops
                 tele.cost_fallback = (
-                    analytic_minimax_flops(self.layer_sizes, step_points,
-                                           n_channels(self._fuse_requests)),
+                    analytic_minimax_flops(
+                        self.layer_sizes, step_points,
+                        n_channels(self._fuse_requests),
+                        n_equations=getattr(self, "_minimax_n_eq", 1)),
                     "analytic-minimax")
             tele.on_fit_start(dict(
                 tf_iter=tf_iter, newton_iter=newton_iter, batch_sz=batch_sz,
@@ -1156,7 +1208,28 @@ class CollocationSolverND:
             # epochs already trained so a warm-restarted fit() explores new
             # pools instead of replaying the previous run's draws
             epoch_offset = len(self.losses)
-            if resample_device is not False:
+            if resample_mode not in ("pool", "ascent"):
+                raise ValueError(
+                    f"resample_mode={resample_mode!r}: expected 'pool' "
+                    "(pool→top-k redraw) or 'ascent' (PACMANN gradient "
+                    "mover)")
+            if resample_mode == "ascent":
+                if resample_device is False:
+                    raise ValueError(
+                        "resample_mode='ascent' is device-resident by "
+                        "construction (the mover is a jitted gradient "
+                        "program); it has no host path — drop "
+                        "resample_device=False")
+                from ..ops.resampling import AscentResampler
+                sampler = AscentResampler(
+                    self._residual_jit, self.domain.xlimits, n_f,
+                    n_steps=resample_ascent_steps,
+                    fresh_frac=uniform_frac, seed=resample_seed,
+                    like=X_f,
+                    score_grad_fn=self._minimax_score_grad_fn())
+                resample_fn = _DeviceResampleHook(self, sampler,
+                                                  epoch_offset)
+            elif resample_device is not False:
                 # device-resident (default): pool→score→select in one
                 # jitted program, double-buffered behind the training
                 # chunks by fit_adam; kept rows carry per-point λ, so

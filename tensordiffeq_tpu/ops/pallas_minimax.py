@@ -1,6 +1,6 @@
 """One fused minimax step: collocation points → SA-λ-weighted residual loss
 → parameter cotangents AND the per-point λ gradient-ascent direction, as a
-single fusion.
+single fusion — for scalar residuals and E-equation systems alike.
 
 The unfused training step evaluates the fused Taylor residual
 (:mod:`.fused`), materialises the ``[N, n_out]`` derivative tables, reduces
@@ -18,23 +18,28 @@ chain.  Two measured costs ride along:
   for the same wavefront gradient at N=8192, w=64).
 
 This module removes both by making the *loss term itself* the fused unit:
-``sq(layers, w, X) = Σ_p w_p · f_p(X)²`` is a ``jax.custom_vjp`` whose
-forward computes the value **and** every cotangent — weight/bias descent
-directions, the per-point ``∂/∂w`` that becomes the SA-λ ascent direction,
-and ``∂/∂X`` for gradient-based collocation adaptation — in one pass; the
-backward is three scalar multiplies.  Because the reduction happens inside
-the fusion, the engine owns its data layout: the wavefront runs
-``flat_matmul`` (the GEMM-friendly form) whenever the point axis is not
-GSPMD-sharded, and the pallas flavor keeps the entire wavefront + its VJP
-VMEM-resident per point-tile, so HBM traffic collapses to: points and λ in,
-scalar loss and parameter cotangents out.
+``sq(layers, w, X) = Σ_e Σ_p w_{p,e} · f_{p,e}(X)²`` is a
+``jax.custom_vjp`` whose forward computes the value **and** every cotangent
+— weight/bias descent directions, the per-point per-equation ``∂/∂w`` that
+becomes the SA-λ ascent direction, and a ``∂/∂X`` summed over equations for
+gradient-based collocation adaptation — in one pass; the backward is three
+scalar multiplies.  A coupled E-equation system (``f_model`` returning a
+tuple — Schrödinger's real/imag pair, reaction–diffusion) stacks its
+single-column residual components as E weight channels; E multiplies only
+this residual-boundary reduction, never the Taylor wavefront, which all
+equations share.  Because the reduction happens inside the fusion, the
+engine owns its data layout: the wavefront runs ``flat_matmul`` (the
+GEMM-friendly form) whenever the point axis is not GSPMD-sharded, and the
+pallas flavor keeps the entire wavefront + its VJP VMEM-resident per
+point-tile, so HBM traffic collapses to: points and λ in, scalar loss and
+parameter cotangents out.
 
-Every weighting mode of the SA family maps onto the per-point ``w`` channel
-(``w = λ²`` for type-1, ``w = g(λ)`` for the g-transform, scalar type-2 λ
-multiplies outside) with the λ chain rule composed by ordinary AD *outside*
-the fusion — elementwise on ``[N, 1]`` arrays, negligible traffic — so
-``ResilientFit``, telemetry, checkpointing, and the optimizer see an
-ordinary loss/grad function.
+Every weighting mode of the SA family maps onto the per-point, per-equation
+``w`` channels (``w = λ²`` for type-1, ``w = g(λ)`` for the g-transform,
+scalar type-2 λ folds linearly into its equation's channel) with the λ
+chain rule composed by ordinary AD *outside* the fusion — elementwise on
+``[N, E]`` arrays, negligible traffic — so ``ResilientFit``, telemetry,
+checkpointing, and the optimizer see an ordinary loss/grad function.
 
 The XLA fallback (``use_pallas=False``) runs the same math as one fused
 jaxpr and is the CPU tier-1 path; the pallas kernel is bit-compared against
@@ -80,8 +85,15 @@ def n_channels(requests: set) -> int:
 
 def residual_columns(f_model: Callable, varnames: Sequence[str], n_out: int,
                      requests: set) -> int:
-    """Column count of the (single-component) residual the loss reduces
-    over — 1 for the scalar-output family the minimax fusion serves."""
+    """Number of single-column residual equations ``f_model`` defines —
+    the E of the fused reduction ``Σ_e Σ_p w_{p,e}·f_{p,e}²`` and the
+    width of its ``w`` channel block.
+
+    A tuple-returning ``f_model`` is an E-equation system (one weight
+    channel per component); a plain array is the E=1 scalar family.
+    Raises :class:`ValueError` for layouts per-point λ weighting cannot
+    serve: any component (or the single residual) that flattens to more
+    than one column per point."""
     ndim = len(varnames)
     X = jnp.zeros((2, ndim), jnp.float32)
 
@@ -91,12 +103,17 @@ def residual_columns(f_model: Callable, varnames: Sequence[str], n_out: int,
         coords = tuple(X[:, i] for i in range(ndim))
         u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
         out = f_model(u, *coords)
-        if isinstance(out, tuple):
-            raise ValueError("minimax fusion serves single-component "
-                             "residuals only")
-        return jnp.reshape(out, (2, -1))
+        parts = out if isinstance(out, tuple) else (out,)
+        return [jnp.reshape(p, (2, -1)) for p in parts]
 
-    return int(jax.eval_shape(run, X).shape[1])
+    shapes = jax.eval_shape(run, X)
+    for e, s in enumerate(shapes):
+        if int(s.shape[1]) != 1:
+            raise ValueError(
+                f"residual component {e} has {int(s.shape[1])} output "
+                "columns; per-point λ weighting is defined for "
+                "single-column residual equations")
+    return len(shapes)
 
 
 def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
@@ -106,12 +123,13 @@ def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
                         interpret: bool = False, compute_dtype=None,
                         use_pallas: bool = False,
                         flat_matmul: bool = True) -> Callable:
-    """Build ``sq(layers, w, X) -> scalar = Σ_p w_p · f_p(X)²`` as the fused
-    minimax unit (see module docstring).
+    """Build ``sq(layers, w, X) -> scalar = Σ_e Σ_p w_{p,e} · f_{p,e}(X)²``
+    as the fused minimax unit (see module docstring).
 
     Args:
-      f_model: the user residual (single component; callers gate on
-        :func:`residual_columns`).
+      f_model: the user residual — a plain array (E=1) or a tuple of E
+        single-column equations (:func:`residual_columns` is the gate and
+        the E count).
       requests: canonical multi-indices the residual needs (primal implied).
       layer_shapes: ``[(in, out), ...]`` static layer dims.
       tile: points per grid step of the pallas kernel — the kernel holds
@@ -129,16 +147,23 @@ def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
         The pallas path always runs flat inside the kernel (Mosaic cannot
         lower the batched form's weight-cotangent transpose).
 
-    ``layers`` is the ``[(W, b), ...]`` list; ``w`` is the per-point weight
-    column ``[N, 1]`` (λ², g(λ), or ones — see
-    :func:`make_minimax_residual_loss`).  The returned callable is
-    ``custom_vjp``-wrapped: differentiating through it costs one fused
-    forward that already carries every cotangent.
+    ``layers`` is the ``[(W, b), ...]`` list; ``w`` is the per-point,
+    per-equation weight block ``[N, E]`` (λ², g(λ), ones, or a folded
+    type-2 scalar per channel — see :func:`make_minimax_residual_loss`;
+    E=1 keeps the historical ``[N, 1]`` column, bit-identical to the
+    scalar kernel).  Padding discipline is per channel: pad rows replicate
+    a real point at weight 0 in EVERY equation channel.  The returned
+    callable is ``custom_vjp``-wrapped: differentiating through it costs
+    one fused forward that already carries every cotangent — ``∂/∂w`` is
+    ``[N, E]`` (per-equation λ-ascent directions), ``∂/∂X`` is summed over
+    equations.  The equation count is exposed as ``sq_fn.n_equations``.
     """
     mis = _sorted_mis(requests)
     ndim = len(varnames)
     n_layers = len(layer_shapes)
     d_in = layer_shapes[0][0]
+    # E: validated single-column equations (raises on unservable layouts)
+    n_eq = residual_columns(f_model, varnames, n_out, requests)
 
     def tile_sq(layers, w, x, flat):
         table = taylor_derivatives(list(layers), x, set(mis),
@@ -147,7 +172,10 @@ def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
         coords = tuple(x[:, i] for i in range(ndim))
         u = SymbolicUFn(_TableEngine(coords, table), varnames, n_out)
         out = f_model(u, *coords)
-        f2 = jnp.square(jnp.reshape(out, (x.shape[0], -1)))
+        parts = out if isinstance(out, tuple) else (out,)
+        cols = [jnp.reshape(p, (x.shape[0], -1)) for p in parts]
+        stacked = cols[0] if len(cols) == 1 else jnp.concatenate(cols, 1)
+        f2 = jnp.square(stacked)
         return jnp.sum(w * f2)
 
     def unflatten(flat):
@@ -218,24 +246,25 @@ def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
             n_tiles = -(-N // tile)
             pad = n_tiles * tile - N
             if pad:
-                # pad by REPLICATING a real collocation point, weighted 0:
-                # zero weight kills the value/dW contribution, and a valid
-                # point keeps the residual finite — an all-zero pad row
-                # would evaluate f_model AT the origin, where
-                # coordinate-singular PDEs (1/x, log x) produce a NaN that
-                # 0·NaN propagates into the whole in-kernel reduction
+                # pad by REPLICATING a real collocation point, weighted 0
+                # in EVERY equation channel: zero weight kills the
+                # value/dW contribution per channel, and a valid point
+                # keeps the residual finite — an all-zero pad row would
+                # evaluate f_model AT the origin, where coordinate-
+                # singular PDEs (1/x, log x) produce a NaN that 0·NaN
+                # propagates into the whole in-kernel reduction
                 X = jnp.concatenate(
                     [X, jnp.broadcast_to(X[:1], (pad, d_in))], 0)
-                w = jnp.concatenate([w, jnp.zeros((pad, 1), w.dtype)], 0)
+                w = jnp.concatenate([w, jnp.zeros((pad, n_eq), w.dtype)], 0)
             outs = pl.pallas_call(
                 kernel,
                 grid=(n_tiles,),
-                in_specs=[_tiled(d_in), _tiled(1)] + w_specs,
+                in_specs=[_tiled(d_in), _tiled(n_eq)] + w_specs,
                 out_specs=[_whole((1, 1))] + w_specs
-                + [_tiled(1), _tiled(d_in)],
+                + [_tiled(n_eq), _tiled(d_in)],
                 out_shape=[jax.ShapeDtypeStruct((1, 1), X.dtype)]
                 + [jax.ShapeDtypeStruct(s, X.dtype) for s in wb_shapes]
-                + [jax.ShapeDtypeStruct((X.shape[0], 1), X.dtype),
+                + [jax.ShapeDtypeStruct((X.shape[0], n_eq), X.dtype),
                    jax.ShapeDtypeStruct(X.shape, X.dtype)],
                 interpret=interpret,
             )(X, w, *flat_layers)
@@ -268,6 +297,9 @@ def build_minimax_sq_fn(f_model: Callable, varnames: Sequence[str],
                      for pair in layers for arr in pair)
         return sq(flat, w, X)
 
+    # consumers (λ routing, the ascent resampler's ones-weight score pass)
+    # size their w block from the unit itself
+    sq_fn.n_equations = n_eq
     return sq_fn
 
 
@@ -276,18 +308,46 @@ def make_minimax_residual_loss(sq_fn: Callable,
                                g=None) -> Callable:
     """Wrap a :func:`build_minimax_sq_fn` unit as the solver's residual
     loss term ``residual_loss(params, lam_res, X) -> scalar``, reproducing
-    :func:`~tensordiffeq_tpu.models.assembly.build_loss_fn`'s λ semantics:
+    :func:`~tensordiffeq_tpu.models.assembly.build_loss_fn`'s λ semantics
+    per equation (``lam_res`` is the solver's per-term λ list — one entry
+    per residual equation, ``None`` = non-adaptive):
 
     * no λ            → ``mean(f²)``              (``w = 1``)
     * per-point type-1 → ``mean((λ·f)²)``          (``w = λ²``)
     * ``g`` transform  → ``mean(g(λ)·f²)``         (``w = g(λ)``)
-    * scalar type-2    → ``λ · mean(f²)``          (outer multiply)
+    * scalar type-2    → ``λ · mean(f²)``          (E=1: outer multiply;
+      systems: λ folds linearly into the equation's weight channel, so
+      AD's broadcast transpose recovers ``∂loss/∂λ_e = mean(f_e²)``
+      exactly)
 
-    The λ chain rule (``∂w/∂λ``) composes by ordinary AD outside the fused
-    unit — elementwise on ``[N, 1]`` — so the fused cotangent ``∂loss/∂w``
-    becomes the SA-λ gradient-ascent direction with no second traversal.
+    For an E-equation system the per-equation columns concatenate into the
+    ``[N, E]`` weight block the widened unit reduces over; the total is
+    ``Σ_e`` of the generic engine's per-equation terms.  The λ chain rule
+    (``∂w/∂λ``) composes by ordinary AD outside the fused unit —
+    elementwise on ``[N, E]`` — so the fused cotangent ``∂loss/∂w``
+    becomes each equation's SA-λ gradient-ascent direction with no second
+    traversal.
     """
     from .taylor import extract_mlp_layers
+
+    n_eq = int(getattr(sq_fn, "n_equations", 1))
+
+    def _weight_column(lam, N, dtype):
+        """One equation's ``[N, 1]`` weight column + optional outer scalar
+        (the E=1 branch keeps the historical outer multiply; systems fold
+        it into the channel)."""
+        if lam is None:
+            return jnp.ones((N, 1), dtype), None
+        if g is not None:
+            return (jnp.broadcast_to(jnp.reshape(g(lam), (-1, 1)), (N, 1)),
+                    None)
+        if weight_outside_sum:
+            # scalar type-2 / NTK weight: scales the term's mean (per-point
+            # λ never reaches this branch — MSE(outside_sum) is scalar-only)
+            return jnp.ones((N, 1), dtype), jnp.reshape(lam, ())
+        # type-1: mean((λ·f)²), per-point or scalar λ
+        lam2 = jnp.broadcast_to(jnp.reshape(lam, (-1, 1)), (N, 1))
+        return lam2 * lam2, None
 
     def residual_loss(params, lam_res, X):
         layers = extract_mlp_layers(params)
@@ -296,21 +356,22 @@ def make_minimax_residual_loss(sq_fn: Callable,
                 "minimax residual loss requires the standard MLP parameter "
                 "structure (Dense_0..Dense_k)")
         N = X.shape[0]
-        lam = lam_res[0] if len(lam_res) > 0 else None
-        outer = None
-        if lam is None:
-            w = jnp.ones((N, 1), X.dtype)
-        elif g is not None:
-            w = jnp.broadcast_to(jnp.reshape(g(lam), (-1, 1)), (N, 1))
-        elif weight_outside_sum:
-            # scalar type-2 / NTK weight: scales the term's mean (per-point
-            # λ never reaches this branch — MSE(outside_sum) is scalar-only)
-            w = jnp.ones((N, 1), X.dtype)
-            outer = jnp.reshape(lam, ())
-        else:  # type-1: mean((λ·f)²), per-point or scalar λ
-            lam2 = jnp.broadcast_to(jnp.reshape(lam, (-1, 1)), (N, 1))
-            w = lam2 * lam2
-        loss = sq_fn(layers, w, X) / N
-        return loss if outer is None else outer * loss
+        if n_eq == 1:
+            lam = lam_res[0] if len(lam_res) > 0 else None
+            w, outer = _weight_column(lam, N, X.dtype)
+            loss = sq_fn(layers, w, X) / N
+            return loss if outer is None else outer * loss
+        cols = []
+        for e in range(n_eq):
+            lam = lam_res[e] if e < len(lam_res) else None
+            w_e, outer_e = _weight_column(lam, N, X.dtype)
+            if outer_e is not None:
+                # λ_e·mean(f_e²) is linear in λ_e: fold it into the
+                # channel so the single fused reduction still covers
+                # every equation (the outer multiply cannot separate
+                # Σ_e afterwards)
+                w_e = w_e * outer_e
+            cols.append(w_e)
+        return sq_fn(layers, jnp.concatenate(cols, axis=1), X) / N
 
     return residual_loss

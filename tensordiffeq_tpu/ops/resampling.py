@@ -41,6 +41,21 @@ Two implementations share the selection semantics:
   rows with index < N_f are *kept* points whose per-point λ (and λ-ascent
   moments) ride through the redraw — lifting the Adaptive_type=1
   restriction.
+
+A third arm implements PACMANN's *ascent* mover proper
+(:class:`AscentResampler`, ``resample_mode="ascent"``): rather than
+drawing a pool and selecting, it moves the retained points K
+normalized-gradient steps UP the residual-magnitude landscape
+(domain-clipped), keeping a stratified ``fresh_frac`` coverage draw in
+place of the lowest-score rows.  When the solver's fused minimax unit is
+adopted, the per-point scores and the ascent direction both fall out of
+ONE ``jax.vjp`` of the fused ``sq`` — the ``∂/∂w`` cotangent is exactly
+``f²`` per point/equation and ``∂/∂X`` is the move direction — so the
+score pass costs no differentiation beyond what the training step
+already fuses.  Moved rows keep their row index (``idx = row``), so
+per-point λ and its ascent moments ride through the move untouched.
+:class:`FamilyAscentResampler` is the same mover vmapped over the
+surrogate-factory model axis.
 """
 
 from __future__ import annotations
@@ -314,6 +329,153 @@ def _score_and_select(pool, f, n_f: int, temp: float, uniform_frac: float,
     return ResampleSwap(X_new, idx, kept, stats)
 
 
+def _ascent_move(score_grad, X, xlimits, n_steps: int, step_frac: float):
+    """Move every row ``n_steps`` normalized-gradient-ascent steps up the
+    residual-magnitude landscape, clipped to the domain box after each
+    step (PACMANN, arXiv:2411.19632).  ``score_grad(X) -> (s [N], g [N,
+    d])`` supplies per-point scores ``s_p = Σ_e f_{e,p}²`` and their point
+    gradient; the per-dimension step is ``step_frac`` of that dimension's
+    extent, so anisotropic domains move proportionally.  The step must
+    resolve the residual ridge it climbs: on Burgers the viscous shock is
+    a few 1e-3 of the x-extent wide, and a 0.02 step overshoots it every
+    iteration — points pile up PAST the ridge and the arm loses to the
+    pool redraw (measured in ``bench.py --mode resample``; 0.005 recovers
+    the win, hence the small default).  Returns ``(X_new, s_first,
+    s_last)`` — the first/last evaluations bracket the move for the
+    ``score_gain`` diagnostic."""
+    lo = jnp.asarray(xlimits[:, 0], jnp.float32)
+    hi = jnp.asarray(xlimits[:, 1], jnp.float32)
+    step = step_frac * (hi - lo)
+    s_first = None
+    for _ in range(max(int(n_steps), 0)):
+        s, g = score_grad(X)
+        if s_first is None:
+            s_first = s
+        gn = jnp.sqrt(jnp.sum(g * g, axis=1, keepdims=True))
+        X = jnp.clip(X + step * g / jnp.maximum(gn,
+                                                jnp.finfo(jnp.float32).tiny),
+                     lo, hi)
+    s_last, _ = score_grad(X)
+    if s_first is None:  # n_steps=0 degenerates to a no-op scoring pass
+        s_first = s_last
+    return X, s_first, s_last
+
+
+class AscentResampler:
+    """PACMANN-style gradient-ascent redraw (arXiv:2411.19632): instead of
+    the pool→top-k draw, *move* the retained collocation points up the
+    residual-magnitude gradient for K steps (domain-clipped), and replace
+    only the ``fresh_frac`` lowest-score rows with a stratified fresh draw
+    so coverage never collapses onto the ascended features.
+
+    Same contract as :class:`DeviceResampler` — ``pipelined=True``, one
+    jitted host-hop-free ``redraw(params, X_cur, epoch) -> ResampleSwap``
+    the fit loop double-buffers behind a training chunk — but the swap's
+    ``idx`` map is near-identity: a moved row keeps its row position
+    (``idx = row``, ``kept=True``), so :func:`carry_rows` carries its
+    per-point λ and λ-ascent moments through the move untouched (the
+    point moves, its trained weight rides along); fresh rows index past
+    ``n_f`` and re-initialize per the adaptive schedule.
+
+    ``score_grad_fn(params, X) -> (scores [N], gX [N, d])`` lets the
+    solver plug in the fused minimax unit: one ``jax.vjp`` of
+    ``sq(layers, 1, X)`` yields the scores (the fused ``∂/∂w`` cotangent
+    IS ``f²`` per point/equation) AND ``∂/∂X`` — the ascent direction
+    costs no differentiation beyond what the fused step already computes.
+    Without it, the default scores through ``residual_fn`` with one
+    ``jax.value_and_grad``."""
+
+    pipelined = True
+
+    def __init__(self, residual_fn: Callable, xlimits: np.ndarray, n_f: int,
+                 *, n_steps: int = 5, step_frac: float = 0.005,
+                 fresh_frac: float = 0.1, seed: int = 0, like=None,
+                 score_grad_fn: Optional[Callable] = None):
+        self.residual_fn = residual_fn
+        # tdq: allow[dtype-discipline] domain limits held in f64 on the HOST; the jitted move casts per-dim bounds to f32
+        self.xlimits = np.asarray(xlimits, np.float64)
+        self.n_f = int(n_f)
+        self.n_steps = int(n_steps)
+        self.step_frac = float(step_frac)
+        self.seed = int(seed)
+        self.n_fresh = int(round(max(min(float(fresh_frac), 1.0), 0.0)
+                                 * self.n_f))
+        self.score_grad_fn = score_grad_fn
+        placement = getattr(like, "sharding", None)
+        if placement is not None \
+                and getattr(placement, "mesh", None) is not None:
+            n_dev = int(np.prod(placement.mesh.devices.shape))
+            if self.n_f % n_dev:
+                raise ValueError(
+                    f"n_f={n_f} must be divisible by the mesh device count "
+                    f"{n_dev} for resampling under dist=True")
+            self.placement = placement
+        else:
+            self.placement = None
+        self._redraw_jit = jax.jit(self._redraw_impl)
+
+    def _score_grad(self, params, X):
+        if self.score_grad_fn is not None:
+            return self.score_grad_fn(params, X)
+
+        def total(Xv):
+            f = self.residual_fn(params, Xv)
+            parts = f if isinstance(f, tuple) else (f,)
+            s = None
+            for p in parts:
+                a = jnp.sum(jnp.square(jnp.reshape(p, (Xv.shape[0], -1))),
+                            axis=1)
+                s = a if s is None else s + a
+            return jnp.sum(s), s
+
+        (_, s), g = jax.value_and_grad(total, has_aux=True)(X)
+        return s, g
+
+    def _place(self, arr):
+        if self.placement is None:
+            return arr
+        return jax.lax.with_sharding_constraint(arr, self.placement)
+
+    def _redraw_impl(self, params, X_cur, epoch):
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        X, s_first, s_last = _ascent_move(
+            lambda Xv: self._score_grad(params, Xv), X_cur, self.xlimits,
+            self.n_steps, self.step_frac)
+        n_f = self.n_f
+        row = jnp.arange(n_f)
+        if self.n_fresh:
+            fresh = _stratified_pool(key, self.n_fresh, self.xlimits)
+            # the lowest-score rows contribute least where they stand:
+            # recycle them as the stratified coverage draw
+            _, worst = jax.lax.top_k(-s_last, self.n_fresh)
+            is_fresh = jnp.zeros((n_f,), bool).at[worst].set(True)
+            X = X.at[worst].set(fresh)
+            fresh_rank = jnp.cumsum(is_fresh.astype(jnp.int32)) - 1
+            idx = jnp.where(is_fresh, n_f + fresh_rank, row)
+            kept = ~is_fresh
+        else:
+            idx, kept = row, jnp.ones((n_f,), bool)
+        X = self._place(X)
+        stats = {
+            "kept_fraction": jnp.mean(kept.astype(jnp.float32)),
+            # mean score after the move over mean score before it — the
+            # ascent analogue of the pool path's selected/pool ratio
+            "score_gain": jnp.mean(s_last) / jnp.maximum(
+                jnp.mean(s_first), jnp.finfo(jnp.float32).tiny),
+            "ascent_steps": jnp.asarray(self.n_steps, jnp.float32),
+        }
+        return ResampleSwap(X, idx, kept, stats)
+
+    def redraw(self, params, X_cur, epoch: int) -> ResampleSwap:
+        """Dispatch one ascent redraw (async — returns device futures)."""
+        return self._redraw_jit(params, X_cur, jnp.asarray(int(epoch)))
+
+    def lower_redraw(self, params, X_cur):
+        """The redraw program's ``Lowered`` (cost analysis without a
+        compile) — the score/ascent-pass FLOP pricing hook."""
+        return self._redraw_jit.lower(params, X_cur, jnp.asarray(0))
+
+
 class FamilyResampler:
     """:class:`DeviceResampler` batched over a surrogate-factory MODEL
     axis: per-member pool → score → select as ONE jitted program for the
@@ -367,6 +529,95 @@ class FamilyResampler:
     def redraw(self, params, X_cur, thetas, epoch: int) -> ResampleSwap:
         """Dispatch one family redraw (async — returns device futures,
         stacked along the model axis)."""
+        return self._redraw_jit(params, X_cur, thetas,
+                                jnp.asarray(int(epoch)))
+
+    def lower_redraw(self, params, X_cur, thetas):
+        """The family redraw's ``Lowered`` (cost analysis, no compile)."""
+        return self._redraw_jit.lower(params, X_cur, thetas,
+                                      jnp.asarray(0))
+
+
+class FamilyAscentResampler:
+    """:class:`AscentResampler` batched over the surrogate-factory MODEL
+    axis: every member moves its own collocation set up its own residual
+    landscape (θ is a traced operand of the member residual), all members
+    in ONE jitted program via ``jax.vmap`` — one dispatch per redraw,
+    exactly like the family training step.  Fresh draws are decorrelated
+    per member via ``fold_in(fold_in(seed, epoch), member)``; the stacked
+    :class:`ResampleSwap` matches :class:`FamilyResampler`'s layout
+    (``X_new [M, n_f, d]``, ``idx``/``kept`` ``[M, n_f]``, stats per
+    member), so :func:`carry_rows_family` carries λ unchanged."""
+
+    pipelined = True
+
+    def __init__(self, residual_fn: Callable, xlimits: np.ndarray,
+                 n_f: int, n_members: int, *, n_steps: int = 5,
+                 step_frac: float = 0.005, fresh_frac: float = 0.1,
+                 seed: int = 0, score_grad_fn: Optional[Callable] = None):
+        self.residual_fn = residual_fn
+        # tdq: allow[dtype-discipline] domain limits held in f64 on the HOST; the jitted move casts per-dim bounds to f32
+        self.xlimits = np.asarray(xlimits, np.float64)
+        self.n_f = int(n_f)
+        self.n_members = int(n_members)
+        self.n_steps = int(n_steps)
+        self.step_frac = float(step_frac)
+        self.seed = int(seed)
+        self.n_fresh = int(round(max(min(float(fresh_frac), 1.0), 0.0)
+                                 * self.n_f))
+        self.score_grad_fn = score_grad_fn
+        self._redraw_jit = jax.jit(self._redraw_impl)
+
+    def _score_grad(self, params, X, theta):
+        if self.score_grad_fn is not None:
+            return self.score_grad_fn(params, X, theta)
+
+        def total(Xv):
+            f = self.residual_fn(params, Xv, theta)
+            parts = f if isinstance(f, tuple) else (f,)
+            s = None
+            for p in parts:
+                a = jnp.sum(jnp.square(jnp.reshape(p, (Xv.shape[0], -1))),
+                            axis=1)
+                s = a if s is None else s + a
+            return jnp.sum(s), s
+
+        (_, s), g = jax.value_and_grad(total, has_aux=True)(X)
+        return s, g
+
+    def _member_redraw(self, params, X_cur, theta, key):
+        X, s_first, s_last = _ascent_move(
+            lambda Xv: self._score_grad(params, Xv, theta), X_cur,
+            self.xlimits, self.n_steps, self.step_frac)
+        n_f = self.n_f
+        row = jnp.arange(n_f)
+        if self.n_fresh:
+            fresh = _stratified_pool(key, self.n_fresh, self.xlimits)
+            _, worst = jax.lax.top_k(-s_last, self.n_fresh)
+            is_fresh = jnp.zeros((n_f,), bool).at[worst].set(True)
+            X = X.at[worst].set(fresh)
+            fresh_rank = jnp.cumsum(is_fresh.astype(jnp.int32)) - 1
+            idx = jnp.where(is_fresh, n_f + fresh_rank, row)
+            kept = ~is_fresh
+        else:
+            idx, kept = row, jnp.ones((n_f,), bool)
+        stats = {
+            "kept_fraction": jnp.mean(kept.astype(jnp.float32)),
+            "score_gain": jnp.mean(s_last) / jnp.maximum(
+                jnp.mean(s_first), jnp.finfo(jnp.float32).tiny),
+            "ascent_steps": jnp.asarray(self.n_steps, jnp.float32),
+        }
+        return ResampleSwap(X, idx, kept, stats)
+
+    def _redraw_impl(self, params, X_cur, thetas, epoch):
+        base = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        keys = jax.vmap(lambda m: jax.random.fold_in(base, m))(
+            jnp.arange(self.n_members))
+        return jax.vmap(self._member_redraw)(params, X_cur, thetas, keys)
+
+    def redraw(self, params, X_cur, thetas, epoch: int) -> ResampleSwap:
+        """Dispatch one family ascent redraw (async, stacked on the
+        model axis)."""
         return self._redraw_jit(params, X_cur, thetas,
                                 jnp.asarray(int(epoch)))
 

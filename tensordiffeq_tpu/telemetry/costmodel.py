@@ -136,7 +136,8 @@ def analytic_step_floor(n_points: int, dims: Sequence[int]) -> float:
 
 def analytic_minimax_flops(dims: Sequence[int], n_points: int,
                            n_channels: int,
-                           passes: float = STEP_FORWARD_PASSES) -> float:
+                           passes: float = STEP_FORWARD_PASSES,
+                           n_equations: int = 1) -> float:
     """Channel-exact analytic model FLOPs for one fused minimax step
     (:mod:`~tensordiffeq_tpu.ops.pallas_minimax`): the wavefront carries
     ``n_channels`` derivative channels through every layer matmul
@@ -148,9 +149,19 @@ def analytic_minimax_flops(dims: Sequence[int], n_points: int,
     guard trips on a minimax-engine step; unlike the generic
     :func:`analytic_step_floor` it prices the channels the kernel actually
     moves, keeping ``cost.mfu`` honest instead of quoting a bound that is
-    ``n_channels``× too low."""
-    return float(n_channels) * analytic_mlp_flops(dims, n_points,
-                                                  passes=passes)
+    ``n_channels``× too low.
+
+    ``n_equations`` is the E of a multi-equation system residual.  The
+    Taylor wavefront is SHARED by every equation — ``n_channels`` already
+    counts the union of their derivative requests, so E does **not**
+    multiply the matmul term.  It only prices the residual-boundary
+    reduction (square, weight-multiply, accumulate ≈ 3 FLOPs per point
+    per equation per pass) — a disclosed, honest widening that stays
+    negligible next to the wavefront (the roofline point PERF.md makes)."""
+    boundary = float(passes) * 3.0 * int(n_equations) * int(n_points)
+    return (float(n_channels) * analytic_mlp_flops(dims, n_points,
+                                                   passes=passes)
+            + boundary)
 
 
 def resolve_flop_basis(measured: Optional[float], floor: float,

@@ -244,7 +244,8 @@ class TrainingTelemetry:
         path, dispatch + swap bookkeeping on the pipelined device path
         (pool scoring itself hides behind the intervening chunk).
         ``stats`` carries the device path's drift diagnostics
-        (``kept_fraction`` / ``score_gain`` / ``lambda_drift``);
+        (``kept_fraction`` / ``score_gain`` / ``lambda_drift``, plus
+        ``ascent_steps`` on the PACMANN ascent arm);
         ``flops`` is the priced ``(flops, basis)`` of the score pass.
         Emits the ``resample.*`` instruments, a ``resample`` event, and a
         ``train.resample`` span on the active tracer."""
@@ -266,6 +267,10 @@ class TrainingTelemetry:
         if "lambda_drift" in stats:
             self.registry.gauge("resample.lambda_drift").set(
                 stats["lambda_drift"])
+        if "ascent_steps" in stats:
+            # PACMANN ascent arm: K gradient steps each moved point took
+            self.registry.gauge("resample.ascent_steps").set(
+                stats["ascent_steps"])
         score_flops, basis = (flops if isinstance(flops, (tuple, list))
                               and len(flops) == 2 else (None, None))
         if score_flops is not None:

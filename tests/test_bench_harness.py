@@ -19,7 +19,7 @@ sys.path.insert(0, REPO)
 @pytest.fixture(scope="module", autouse=True)
 def resample_bench_proc():
     """Start the --resample contract subprocess when the FIRST test of
-    this module runs and leave it cooking: the race (3 training arms,
+    this module runs and leave it cooking: the race (4 training arms,
     ~4 min on the throttled CI host) overlaps the module's OTHER
     subprocess contract tests (minimax / serving / fleet / elastic —
     whose supervisors spend much of their wall in probe timeouts and
@@ -461,6 +461,14 @@ def test_minimax_json_contract_on_cpu_fallback(tmp_path):
     assert p["vs_baseline"] >= 1.1, p
     assert p["loss_drift"] is not None
     assert p["loss_drift"] <= 1e-4 * abs(p["minimax"]["loss"])
+    # the multi-component arm (PR 16): the coupled 2-equation system
+    # rides the widened [N, E] fused unit with the same acceptance bar —
+    # measured reduction at ~zero drift (2.86x on this host)
+    sys_arm = p["system"]
+    assert sys_arm["n_equations"] == 2
+    assert sys_arm["fused"]["engine"] == "fused-minimax-xla"
+    assert sys_arm["step_time_reduction"] >= 1.1, sys_arm
+    assert sys_arm["loss_drift"] <= 1e-4 * abs(sys_arm["fused"]["loss"])
     assert p["backend"] == "cpu"  # this env: the fallback really ran
 
 
@@ -598,23 +606,39 @@ def test_resample_payload_semantics():
            "redraws": 5,
            "stall_s": {"mean": 0.28, "p50": 0.0015, "p99": 1.4,
                        "max": 1.4}}
-    p = pay({"fixed": fixed, "adaptive-host": host, "adaptive-device": dev})
+    pac = {"epochs_to_gate": 1200, "rel_l2_final": 0.05, "wall_s": 32.0,
+           "redraws": 5, "ascent_steps": 3,
+           "stall_s": {"mean": 0.5, "p50": 0.002, "p99": 2.0, "max": 2.1}}
+    full = {"fixed": fixed, "adaptive-host": host, "adaptive-device": dev,
+            "pacmann": pac}
+    p = pay(full)
     assert p["value"] == 2.0 and p["vs_baseline"] == 2.0
     assert "partial" not in p and "note" not in p
     assert p["redraw_stall_reduction"] == 8.0  # p50 ratio, not mean
-    assert p["redraw_stall_s_p50"] == {"host": 0.012, "device": 0.0015}
+    assert p["redraw_stall_s_p50"] == {"host": 0.012, "device": 0.0015,
+                                       "pacmann": 0.002}
     assert p["unit"] == "x fewer steps to rel-L2 gate"
+    # the ascent arm's two reads: steps-to-gate vs fixed and vs the
+    # pool->top-k device arm (<=1 = the mover needs no more steps)
+    assert p["pacmann_vs_fixed"] == 2.5
+    assert p["pacmann_vs_pool"] == 0.8
     # fixed never reached the gate: quote vs the full budget, as a
-    # disclosed lower bound — never an invented epochs number
-    p = pay({"fixed": dict(fixed, epochs_to_gate=None),
-             "adaptive-host": host, "adaptive-device": dev})
+    # disclosed lower bound — never an invented epochs number (the
+    # pacmann-vs-fixed read lower-bounds the same way)
+    p = pay(dict(full, fixed=dict(fixed, epochs_to_gate=None)))
     assert p["value"] == 2.0 and "lower bound" in p["note"]
-    # the ADAPTIVE arm never reached it: no value, no fake win
-    p = pay({"fixed": fixed, "adaptive-host": host,
-             "adaptive-device": dict(dev, epochs_to_gate=None)})
+    assert p["pacmann_vs_fixed"] == 2.5  # 3000 budget / 1200
+    # the ADAPTIVE arm never reached it: no value, no fake win — and a
+    # gate-missing pacmann arm publishes NO pacmann reads
+    p = pay(dict(full, **{"adaptive-device": dict(dev, epochs_to_gate=None),
+                          "pacmann": dict(pac, epochs_to_gate=None)}))
     assert p["value"] is None
+    assert "pacmann_vs_fixed" not in p and "pacmann_vs_pool" not in p
     # a salvaged mid-race line is marked partial (save_tpu_cache and the
-    # watcher's have_complete both refuse partials)
+    # watcher's have_complete both refuse partials) — fewer than FOUR
+    # arms now that the pacmann arm is in the race
+    p = pay({"fixed": fixed, "adaptive-host": host, "adaptive-device": dev})
+    assert "partial" in p
     p = pay({"fixed": fixed})
     assert "partial" in p and p["value"] is None
 
@@ -743,10 +767,13 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     host, deterministic by seed): (1) the device-resident adaptive arm
     reaches the rel-L2 gate in measurably fewer optimizer steps than
     fixed LHS at equal N_f (fixed never reaches it inside the budget, so
-    the quoted speedup is a disclosed lower bound — measured 1.212), and
+    the quoted speedup is a disclosed lower bound — measured 1.212),
     (2) the pipelined redraw's per-redraw host-visible stall (p50) is a
     fraction of the synchronous host path's (measured 75x on this host;
-    the >=3x bar leaves throttle headroom).  KEEP THIS TEST LAST IN THE
+    the >=3x bar leaves throttle headroom), and (3) the PACMANN ascent
+    arm reaches the gate in fewer steps than the pool->top-k arm at the
+    same cadence (measured 2300 vs 3300) with the same pipelined ms-band
+    stall.  KEEP THIS TEST LAST IN THE
     FILE: the subprocess was started by the module fixture before the
     other contract tests ran, so joining here pays only the residual
     wall, not the full race."""
@@ -756,8 +783,9 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     assert len(lines) == 1, out  # supervisor: exactly one line
     p = json.loads(lines[0])
     assert p["unit"] == "x fewer steps to rel-L2 gate"
-    assert set(p["arms"]) == {"fixed", "adaptive-host", "adaptive-device"}
-    assert "partial" not in p  # all three arms completed
+    assert set(p["arms"]) == {"fixed", "adaptive-host", "adaptive-device",
+                              "pacmann"}
+    assert "partial" not in p  # all four arms completed
     dev, fixed = p["arms"]["adaptive-device"], p["arms"]["fixed"]
     # (1) the adaptive race: the device arm reached the gate, fixed LHS
     # did not (or did later) — the headline speedup is real and >1
@@ -773,4 +801,18 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     assert p["redraw_stall_s_p50"]["device"] < \
         p["redraw_stall_s_p50"]["host"]
     assert p["redraw_stall_reduction"] >= 3.0
+    # (3) the PACMANN ascent arm (PR 16): the mover reaches the gate in
+    # no more steps than the pool->top-k redraw (measured 2300 vs 3300
+    # on this host, deterministic by seed), its pipelined redraw stays
+    # in the same ms stall band as the device arm, and the ascent
+    # telemetry rode through (3 tuned steps, partial coverage refresh)
+    pac = p["arms"]["pacmann"]
+    assert pac["epochs_to_gate"] is not None
+    assert pac["rel_l2_final"] <= p["gate_rel_l2"]
+    assert p["pacmann_vs_pool"] <= 1.0
+    assert p["pacmann_vs_fixed"] > 1.0
+    assert pac["redraws"] >= 1 and pac["ascent_steps"] == 3
+    assert pac["score_gain"] > 1.0 and 0.0 < pac["kept_fraction"] < 1.0
+    assert p["redraw_stall_s_p50"]["pacmann"] < \
+        p["redraw_stall_s_p50"]["host"]
     assert p["backend"] == "cpu"  # this env: the fallback really ran

@@ -74,7 +74,8 @@ def test_jaxpr_audit_pins_zero_host_hops_in_hot_programs():
     program per family step" (PR 15) is judged here like its PR 12
     siblings."""
     from tensordiffeq_tpu.analysis.jaxpr_audit import HOT_PROGRAMS, audit
-    assert {"fused-minimax-step", "device-resampler",
+    assert {"fused-minimax-step", "fused-minimax-system-step",
+            "device-resampler", "ascent-resampler",
             "vmapped-factory-step"} <= set(HOT_PROGRAMS)
     for name in HOT_PROGRAMS:
         report = audit(name)

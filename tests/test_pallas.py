@@ -202,3 +202,94 @@ def test_pallas_point_cotangent_matches_xla():
         lambda x: loss_of_X(taylor_derivatives(layers, x, reqs)))(X)
     np.testing.assert_allclose(np.asarray(gX_pl), np.asarray(gX_xla),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_minimax_system_matches_xla_fused():
+    """E=2 widening (PR 16): the interpret-mode pallas kernel and the
+    fused-XLA fallback must agree on the SYSTEM unit — a coupled
+    2-equation Schrödinger-type residual with a [N, 2] per-equation
+    weight block, through the pad path (n=70) — on the loss value AND
+    every cotangent: parameter grads, the per-point PER-EQUATION ∂/∂w
+    (the SA-λ ascent directions, one channel per equation), and ∂/∂X
+    summed over equations."""
+    from tensordiffeq_tpu.ops.derivatives import grad
+    from tensordiffeq_tpu.ops.fused import analyze_f_model
+    from tensordiffeq_tpu.ops.pallas_minimax import build_minimax_sq_fn
+
+    layers, shapes, X = _setup(n_out=2, n=70)  # 70 = 2*32 + 6: pad path
+
+    def f_model(u, x, t):  # cross-coupled cubic system
+        uv, vv = u[0](x, t), u[1](x, t)
+        sq = uv ** 2 + vv ** 2
+        f_u = grad(u[0], "t")(x, t) \
+            + 0.5 * grad(grad(u[1], "x"), "x")(x, t) + sq * vv
+        f_v = grad(u[1], "t")(x, t) \
+            - 0.5 * grad(grad(u[0], "x"), "x")(x, t) - sq * uv
+        return f_u, f_v
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 2)
+    assert reqs is not None
+    w = jnp.asarray(np.random.RandomState(3).rand(70, 2), jnp.float32)
+
+    sq_xla = build_minimax_sq_fn(f_model, ("x", "t"), 2, reqs, shapes)
+    sq_pl = build_minimax_sq_fn(f_model, ("x", "t"), 2, reqs, shapes,
+                                tile=32, interpret=True, use_pallas=True)
+    assert sq_xla.n_equations == 2 and sq_pl.n_equations == 2
+
+    def val_and_cotangents(sq):
+        val, vjp = jax.vjp(sq, layers, w, X)
+        gl, gw, gx = vjp(jnp.ones((), val.dtype))
+        return val, gl, gw, gx
+
+    v_x, gl_x, gw_x, gx_x = val_and_cotangents(sq_xla)
+    v_p, gl_p, gw_p, gx_p = val_and_cotangents(sq_pl)
+    np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_x),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gl_p),
+                    jax.tree_util.tree_leaves(gl_x)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+    assert gw_p.shape == (70, 2)  # one λ-ascent channel per equation
+    np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_x),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_x),
+                               rtol=1e-5, atol=1e-5)
+    # the ∂/∂w cotangent is exactly f² per point/equation: feed ones and
+    # the value must be the sum of the cotangent block
+    ones = jnp.ones_like(w)
+    v1, vjp1 = jax.vjp(sq_pl, layers, ones, X)
+    _, gw1, _ = vjp1(jnp.ones((), v1.dtype))
+    np.testing.assert_allclose(float(v1), float(jnp.sum(gw1)), rtol=1e-5)
+
+
+def test_pallas_minimax_system_pad_rows_stay_finite_for_singular_f_model():
+    """Per-channel padding discipline at E=2: pad rows replicate a real
+    point at weight 0 in EVERY equation channel, so a system residual
+    singular at the origin (1/x in one equation only) stays finite
+    through the widened in-kernel reduction whenever N is not a tile
+    multiple."""
+    from tensordiffeq_tpu.ops.derivatives import grad
+    from tensordiffeq_tpu.ops.fused import analyze_f_model
+    from tensordiffeq_tpu.ops.pallas_minimax import build_minimax_sq_fn
+
+    net = neural_net([2, 16, 16, 2])
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))
+    layers = extract_mlp_layers(params)
+    shapes = [(W.shape[0], W.shape[1]) for W, _ in layers]
+    rng = np.random.RandomState(5)
+    X = jnp.asarray(np.stack([rng.uniform(0.5, 1.5, 40),
+                              rng.uniform(-1, 1, 40)], -1), jnp.float32)
+
+    def f_model(u, x, t):  # eq 0 carries the cylindrical 1/x singularity
+        return (grad(u[0], "t")(x, t) + grad(u[0], "x")(x, t) / x,
+                grad(u[1], "t")(x, t) - u[0](x, t))
+
+    reqs = analyze_f_model(f_model, ("x", "t"), 2)
+    w = jnp.asarray(rng.rand(40, 2), jnp.float32)
+    sq_xla = build_minimax_sq_fn(f_model, ("x", "t"), 2, reqs, shapes)
+    sq_pl = build_minimax_sq_fn(f_model, ("x", "t"), 2, reqs, shapes,
+                                tile=32, interpret=True, use_pallas=True)
+    v_p = sq_pl(layers, w, X)
+    assert np.isfinite(float(v_p)), "pad rows poisoned the system reduction"
+    np.testing.assert_allclose(np.asarray(v_p), np.asarray(sq_xla(layers, w, X)),
+                               rtol=1e-5, atol=1e-6)

@@ -642,3 +642,99 @@ def test_minimax_autotune_adoption_is_measured(monkeypatch):
     # the iterator and fail the build
     s = build(times=[2.0, 1.0], minimax=True)
     assert s._minimax_kind == "xla"
+
+
+def make_coupled_system(n_f=256, nx=32, nt=9, seed=0):
+    """Schrödinger-type coupled 2-equation system (the bench.py
+    ``build_system_solver`` shape at test sizes): tuple-returning
+    ``f_model`` with cross-coupled cubic terms, per-point SA λ on BOTH
+    residual channels."""
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], nx)
+    domain.add("t", [0.0, 1.0], nt)
+    domain.generate_collocation_points(n_f, seed=seed)
+    ics = IC(domain,
+             [lambda x: x ** 2 * np.cos(np.pi * x), lambda x: 0.0 * x],
+             var=[["x"], ["x"]])
+
+    def deriv_model(u, x, t):
+        return (u[0](x, t), u[1](x, t),
+                grad(u[0], "x")(x, t), grad(u[1], "x")(x, t))
+
+    bcs = [ics, periodicBC(domain, ["x"], [deriv_model])]
+
+    def f_model(u, x, t):
+        uv, vv = u[0](x, t), u[1](x, t)
+        sq = uv ** 2 + vv ** 2
+        f_u = grad(u[0], "t")(x, t) \
+            + 0.5 * grad(grad(u[1], "x"), "x")(x, t) + sq * vv
+        f_v = grad(u[1], "t")(x, t) \
+            - 0.5 * grad(grad(u[0], "x"), "x")(x, t) - sq * uv
+        return f_u, f_v
+
+    return domain, bcs, f_model
+
+
+def test_minimax_system_adopts_and_matches_unfused():
+    """PR 16 acceptance: a tuple-returning 2-equation f_model with
+    per-point SA λ on both channels adopts the WIDENED fused minimax
+    unit (E=2: one weight channel per equation) behind the same numeric
+    cross-check gate — and the SA trajectory matches the unfused loss
+    within the documented 1e-4 relative band."""
+    def build(minimax):
+        domain, bcs, f_model = make_coupled_system(n_f=256)
+        rng = np.random.RandomState(0)
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 10, 10, 2], f_model, domain, bcs, Adaptive_type=1,
+                  dict_adaptive={"residual": [True, True],
+                                 "BCs": [True, False]},
+                  init_weights={"residual": [rng.rand(256, 1),
+                                             rng.rand(256, 1)],
+                                "BCs": [100 * rng.rand(32, 1), None]},
+                  minimax=minimax)
+        return s
+
+    s_mm = build(None)  # default: auto-adopt
+    assert s_mm._minimax_kind == "xla"  # CPU: the fused-XLA flavor
+    assert s_mm._minimax_sq.n_equations == 2  # the system channel count
+    s_un = build(False)
+    assert s_un._minimax_kind is None
+
+    # the compile-time cross-check bar, re-asserted per evaluation
+    t_mm, _ = s_mm.update_loss()
+    t_un, _ = s_un.update_loss()
+    assert abs(float(t_mm) - float(t_un)) <= 1e-4 * abs(float(t_un))
+    # a short SA fit trajectory stays inside the band, and BOTH λ
+    # channels trained through the fused per-equation ascent cotangent
+    s_mm.fit(tf_iter=20, newton_iter=0, chunk=10)
+    s_un.fit(tf_iter=20, newton_iter=0, chunk=10)
+    mm = [float(d["Total Loss"]) for d in s_mm.losses]
+    un = [float(d["Total Loss"]) for d in s_un.losses]
+    np.testing.assert_allclose(mm, un, rtol=5e-4)
+    rng = np.random.RandomState(0)
+    lam0_u, lam0_v = rng.rand(256, 1), rng.rand(256, 1)
+    assert not np.allclose(np.asarray(s_mm.lambdas["residual"][0]), lam0_u)
+    assert not np.allclose(np.asarray(s_mm.lambdas["residual"][1]), lam0_v)
+
+
+def test_minimax_one_equation_tuple_anchors_to_scalar_path():
+    """E=1 anchor: a 1-tuple-returning f_model must ride the SAME fused
+    unit as the plain-array form — n_equations=1, bit-identical loss —
+    so widening to systems cannot have perturbed the scalar fast path."""
+    domain, bcs, f_model = make_burgers(n_f=256)
+
+    def f_tuple(u, x, t):
+        return (f_model(u, x, t),)
+
+    def build(fm):
+        s = CollocationSolverND(verbose=False)
+        s.compile([2, 10, 10, 1], fm, domain, bcs, minimax=True)
+        return s
+
+    s_a, s_b = build(f_model), build(f_tuple)
+    assert s_a._minimax_kind == "xla" and s_b._minimax_kind == "xla"
+    assert s_a._minimax_sq.n_equations == 1
+    assert s_b._minimax_sq.n_equations == 1
+    t_a, _ = s_a.update_loss()
+    t_b, _ = s_b.update_loss()
+    assert float(t_a) == float(t_b)  # bit-identical, not merely close

@@ -2068,6 +2068,48 @@ def worker_main(args):
 
         payload = bench_resample(r_nf, r_widths, r_adam, r_newton,
                                  r_every, r_eval, r_gate, on_arm=on_arm)
+    elif args.zoo:
+        # the PDE-zoo scorecard (tensordiffeq_tpu/zoo/): race the three
+        # adaptive arms per registered entry at its declared (budget,
+        # gate), streaming the card-so-far after every completed entry so
+        # a timeout salvages a disclosed subset.  BENCH_ZOO_ENTRIES
+        # (comma-separated ids) selects a subset, BENCH_ZOO_SIZE picks
+        # the declared operating point, and BENCH_ZOO_CAP (or BENCH_FAST)
+        # caps each optimizer phase — capped cards say so and the diff
+        # gate skips their gate comparison.
+        from tensordiffeq_tpu import zoo as tdq_zoo
+        z_ids = [s for s in
+                 os.environ.get("BENCH_ZOO_ENTRIES", "").split(",")
+                 if s] or None
+        z_size = os.environ.get("BENCH_ZOO_SIZE", "micro")
+        z_cap = (int(os.environ["BENCH_ZOO_CAP"])
+                 if "BENCH_ZOO_CAP" in os.environ
+                 else (60 if fast else None))
+
+        def zoo_payload(card):
+            done = card["entries"]
+            return {
+                "metric": f"PDE-zoo scorecard ({z_size}): "
+                          "entries gated (any arm)",
+                "value": sum(1 for e in done.values()
+                             if any(a["gated"]
+                                    for a in e["arms"].values())),
+                "unit": "entries",
+                "vs_baseline": None,
+                "entries_run": len(done),
+                "systems": sum(1 for e in done.values() if e["system"]),
+                "arms_gated": sum(1 for e in done.values()
+                                  for a in e["arms"].values()
+                                  if a["gated"]),
+                "scorecard": card,
+            }
+
+        def on_entry(card):
+            print(json.dumps(zoo_payload(card)), flush=True)
+
+        card = tdq_zoo.run_scorecard(z_ids, z_size, budget_cap=z_cap,
+                                     on_entry=on_entry)
+        payload = zoo_payload(card)
     elif args.full:
         def full_payload(r):
             p = {"metric":
@@ -2286,6 +2328,27 @@ def lint_verdict():
             "ok": not findings, "value": len(findings), "unit": "findings",
             "files_scanned": len(modules),
             "findings": [f.format() for f in findings]}
+
+
+def zoo_diff_verdict(target, baseline_path=None):
+    """``bench.py --zoo-diff`` body: hold a fresh scorecard (a ``--zoo``
+    payload JSON or a bare scorecard document) to the checked-in
+    ``SCORECARD.json`` baseline via
+    :func:`tensordiffeq_tpu.zoo.diff_scorecards`.  Returns the verdict
+    dict; the caller turns ``ok`` into the exit code (3 on regression)."""
+    from tensordiffeq_tpu.zoo import diff_scorecards
+    base = baseline_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "SCORECARD.json")
+    with open(base) as fh:
+        baseline = json.load(fh)
+    with open(target) as fh:
+        current = json.load(fh)
+    verdict = {"metric": "PDE-zoo scorecard diff vs checked-in baseline",
+               **diff_scorecards(baseline, current)}
+    verdict["value"] = len(verdict["regressions"])
+    verdict["unit"] = "regressions"
+    verdict["baseline"] = base
+    return verdict
 
 
 def slo_verdict(target):
@@ -2577,10 +2640,27 @@ def main():
                          "throughput of a 64-member coefficient-sweep "
                          "family as ONE vmapped program vs the same "
                          "members trained sequentially")
+    ap.add_argument("--zoo", action="store_true",
+                    help="PDE-zoo scorecard: race the three adaptive "
+                         "arms (fixed LHS / pool top-k / PACMANN ascent) "
+                         "over the registered entries at their declared "
+                         "(budget, gate) and emit one machine-readable "
+                         "scorecard (see tensordiffeq_tpu/zoo/ and "
+                         "SCORECARD.json)")
+    ap.add_argument("--zoo-diff", metavar="TARGET",
+                    help="CI gate, not a measurement: diff a scorecard "
+                         "JSON (bench --zoo payload or bare scorecard) "
+                         "against the checked-in SCORECARD.json baseline "
+                         "and exit 3 on a gated-entry regression or a "
+                         "fused-engine downgrade (like --slo/--lint, "
+                         "exempt from the exit-0-always contract)")
+    ap.add_argument("--zoo-baseline", metavar="PATH",
+                    help="override the baseline scorecard for --zoo-diff "
+                         "(default: SCORECARD.json next to bench.py)")
     ap.add_argument("--mode", choices=["default", "full", "engines",
                                        "precision", "minimax", "scale",
                                        "remat", "serving", "fleet",
-                                       "resample", "factory"],
+                                       "resample", "factory", "zoo"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
     ap.add_argument("--slo", metavar="TARGET",
@@ -2631,6 +2711,13 @@ def main():
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 3)
 
+    if args.zoo_diff:
+        # CI gate over scorecards: no probe, no worker, no cache — and
+        # deliberately NOT exit-0-always (the regression IS the signal)
+        verdict = zoo_diff_verdict(args.zoo_diff, args.zoo_baseline)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 3)
+
     if args.elastic:
         # driver-process mode: it spawns its own CPU cluster subprocesses
         # (no accelerator probe, no worker protocol, no TPU cache) — the
@@ -2652,7 +2739,7 @@ def main():
     mode_flags = [f for f in ("--full", "--engines", "--precision",
                               "--minimax", "--scale", "--remat",
                               "--serving", "--fleet", "--resample",
-                              "--factory")
+                              "--factory", "--zoo")
                   if getattr(args, f.lstrip("-"))]
 
     # Total wall budget.  The driver's no-flag invocation must finish well
@@ -2661,7 +2748,8 @@ def main():
     default_budget = {"default": 1140, "engines": 2400, "precision": 2400,
                       "minimax": 1800, "scale": 7200, "remat": 2400,
                       "serving": 1800, "fleet": 1800, "resample": 3600,
-                      "factory": 1800, "full": 86400}[mode_name(mode_flags)]
+                      "factory": 1800, "zoo": 7200,
+                      "full": 86400}[mode_name(mode_flags)]
     budget = float(os.environ.get("BENCH_BUDGET", default_budget))
     t_start = time.time()
 

@@ -88,6 +88,27 @@ def scaled(args, full: int, quick: int) -> int:
     return quick if args.quick else full
 
 
+def zoo_spec(entry, quick: bool, quick_budget=(200, 100), **overrides):
+    """Resolve an example's operating point from its zoo registry entry
+    (``tensordiffeq_tpu/zoo`` — the single source of truth; entry↔example
+    drift is structurally impossible): the declared ``full`` size, or for
+    ``--quick`` the declared ``micro`` problem at smoke iteration counts
+    (the CI wall cannot afford micro's real budget).  Non-zero
+    ``overrides`` (``n_f=``, ``widths=``, ``budget=``) are CLI scale
+    knobs layered on top."""
+    import dataclasses
+
+    from tensordiffeq_tpu.zoo import Budget
+
+    spec = entry.spec("micro" if quick else "full")
+    if quick:
+        spec = dataclasses.replace(spec, budget=Budget(*quick_budget))
+    clean = {k: v for k, v in overrides.items() if v}
+    if clean:
+        spec = dataclasses.replace(spec, **clean)
+    return spec
+
+
 def fit_resumable(solver, tf_iter: int, newton_iter: int = 0,
                   quick: bool = False, **fit_kw):
     """``solver.fit`` with optional cross-run resume.

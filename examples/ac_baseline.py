@@ -10,62 +10,56 @@ import numpy as np
 from _common import example_args, scaled, fit_resumable
 
 import tensordiffeq_tpu as tdq
-from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
-                              periodicBC)
+from tensordiffeq_tpu import CollocationSolverND
 from tensordiffeq_tpu.exact import allen_cahn_solution
 
 
+def _sa_spec(n_f: int, nx: int, nt: int, widths):
+    """An explicit operating point over the zoo entry's declared ``full``
+    size (the registry owns the problem; callers own the scale knobs)."""
+    import dataclasses
+
+    from tensordiffeq_tpu import zoo
+
+    return dataclasses.replace(zoo.get("allen-cahn-sa").spec("full"),
+                               n_f=n_f, widths=tuple(widths),
+                               grid=(nx, nt))
+
+
 def build_problem(n_f: int, nx: int = 512, nt: int = 201, seed: int = 0):
-    domain = DomainND(["x", "t"], time_var="t")
-    domain.add("x", [-1.0, 1.0], nx)
-    domain.add("t", [0.0, 1.0], nt)
-    domain.generate_collocation_points(n_f, seed=seed)
+    """The Allen-Cahn problem, resolved from the zoo registry (entry
+    ``allen-cahn-sa`` — single source of truth); the SA compile config is
+    dropped, this is the plain baseline."""
+    from tensordiffeq_tpu import zoo
 
-    def func_ic(x):
-        return x ** 2 * np.cos(np.pi * x)
-
-    def deriv_model(u, x, t):
-        return u(x, t), grad(u, "x")(x, t)
-
-    bcs = [IC(domain, [func_ic], var=[["x"]]),
-           periodicBC(domain, ["x"], [deriv_model])]
-
-    def f_model(u, x, t):
-        u_xx = grad(grad(u, "x"), "x")
-        u_t = grad(u, "t")
-        uv = u(x, t)
-        return u_t(x, t) - 0.0001 * u_xx(x, t) + 5.0 * uv ** 3 - 5.0 * uv
-
-    return domain, bcs, f_model
+    entry = zoo.get("allen-cahn-sa")
+    problem = entry.build(_sa_spec(n_f, nx, nt, (32,)), seed=seed)
+    return problem.domain, list(problem.bcs), problem.f_model
 
 
 def build_sa_solver(n_f: int, nx: int, nt: int, widths, periodic=False,
                     seed: int = 0, verbose: bool = False):
     """The flagship SA config as ONE shared builder (reference
     ``AC-SA.py:12,55-56,64``): λ_res ~ U[0,1] per collocation point,
-    λ_IC ~ 100·U[0,1] per IC point, minimax via Adaptive_type=1;
-    ``periodic=True`` swaps in the exactly-periodic harmonic ansatz
-    (beyond-reference ``periodic_net``, generic residual engine).  Used
-    by ``ac_sa.py``, the north-star drivers, and the CPU hedges so the
-    arms can never de-synchronize.  ``seed`` drives ALL THREE RNG
-    consumers — the collocation draw (``build_problem``), the network
-    init (``CollocationSolverND(seed=)``), and the λ init — so one seed
-    pins the whole run."""
+    λ_IC ~ 100·U[0,1] per IC point, minimax via Adaptive_type=1 — now
+    resolved from the zoo registry (entry ``allen-cahn-sa``), so this
+    wrapper, the scorecard, and the north-star drivers share ONE
+    declaration and can never de-synchronize.  ``periodic=True`` swaps in
+    the exactly-periodic harmonic ansatz (beyond-reference
+    ``periodic_net``, generic residual engine).  ``seed`` drives ALL
+    THREE RNG consumers — the collocation draw, the network init, and
+    the λ init — so one seed pins the whole run."""
     import tensordiffeq_tpu as tdq
-    from tensordiffeq_tpu import CollocationSolverND
+    from tensordiffeq_tpu import zoo
 
-    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt, seed=seed)
-    rng = np.random.RandomState(seed)
-    layers = [2, *widths, 1]
-    network = tdq.periodic_net(layers, domain, ["x"]) if periodic else None
-    solver = CollocationSolverND(verbose=verbose, seed=seed)
-    solver.compile(
-        layers, f_model, domain, bcs, Adaptive_type=1,
-        dict_adaptive={"residual": [True], "BCs": [True, False]},
-        init_weights={"residual": [rng.rand(n_f, 1)],
-                      "BCs": [100.0 * rng.rand(nx, 1), None]},
-        network=network)
-    return solver
+    network_factory = None
+    if periodic:
+        def network_factory(layers, domain):
+            return tdq.periodic_net(layers, domain, ["x"])
+    return zoo.build_solver(zoo.get("allen-cahn-sa"),
+                            spec=_sa_spec(n_f, nx, nt, widths), seed=seed,
+                            network_factory=network_factory,
+                            verbose=verbose)
 
 
 def evaluate(solver, args, name):

@@ -9,29 +9,32 @@ network descends.  (The reference script passes the stale string
 SURVEY §2.4.7; the working encoding is Adaptive_type=1.)
 """
 
-from _common import example_args, scaled, fit_resumable
+from _common import example_args, fit_resumable, zoo_spec
 
 from ac_baseline import build_sa_solver, evaluate
 
 import tensordiffeq_tpu as tdq
+from tensordiffeq_tpu import zoo
+
+ENTRY = zoo.get("allen-cahn-sa")
 
 
 def main():
     args = example_args("Allen-Cahn Self-Adaptive PINN",
                         flags=("periodic-net",))
-    n_f = scaled(args, 50_000, 2_000)
-    nx = 512 if not args.quick else 64
-    widths = [128] * 4 if not args.quick else [32] * 2
+    # one source of truth: sizes/budgets come from the zoo entry; the
+    # SA compile config is inside its builder (ac_baseline wraps it)
+    spec = zoo_spec(ENTRY, args.quick)
+    nx, nt = spec.grid
 
     # --periodic-net: beyond-reference exactly-periodic embedding ansatz
     # (networks.PeriodicMLP) — the x-periodicity the reference enforces
     # softly is built into the network, at the cost of the generic
     # (non-fused) residual engine.
-    solver = build_sa_solver(n_f, nx, 201 if not args.quick else 21,
-                             widths, periodic=args.periodic_net,
-                             verbose=True)
-    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100))
+    solver = build_sa_solver(spec.n_f, nx, nt, list(spec.widths),
+                             periodic=args.periodic_net, verbose=True)
+    fit_resumable(solver, quick=args.quick, tf_iter=spec.budget.adam,
+                  newton_iter=spec.budget.lbfgs)
     err = evaluate(solver, args, "ac_sa")
     if args.plot:
         tdq.plotting.plot_weights(solver, save_path=f"{args.plot}/ac_sa_weights.png")

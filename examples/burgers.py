@@ -1,57 +1,46 @@
 """Forward viscous Burgers PINN (reference ``examples/burgers-new.py``).
 
 u_t + u u_x = (0.01/pi) u_xx on x in [-1,1], t in [0,1];
-u(x,0) = -sin(pi x), u(+-1,t) = 0.  N_f=10k, 2-20x8-1 tanh MLP,
-10k Adam + 10k L-BFGS; validates rel-L2 against the Cole-Hopf solution.
+u(x,0) = -sin(pi x), u(+-1,t) = 0.  Validates rel-L2 against the
+Cole-Hopf solution.
+
+The problem declaration (domain, BCs, residual, sizes, budgets, gate)
+lives in the zoo registry (``tensordiffeq_tpu.zoo``, entry ``burgers``)
+— this script is a thin CLI wrapper that resolves its config from there,
+so the example and the scorecard can never drift apart.
 
 ``--resample N`` turns on residual-importance collocation resampling
 (beyond-reference, ops/resampling.py): redraw the N_f points every N Adam
 epochs toward where |f| is large — the shock line here.
 """
 
-import numpy as np
-
-from _common import example_args, scaled, fit_resumable
+from _common import example_args, fit_resumable, zoo_spec
 
 import tensordiffeq_tpu as tdq
-from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, dirichletBC,
-                              grad)
+from tensordiffeq_tpu import zoo
 from tensordiffeq_tpu.exact import burgers_solution
+
+ENTRY = zoo.get("burgers")
 
 
 def main():
     args = example_args("Burgers shock forward PINN",
                         resample=(0, "redraw collocation points every N "
                                      "Adam epochs (0 = reference fixed set)"))
+    spec = zoo_spec(ENTRY, args.quick)
 
-    domain = DomainND(["x", "t"], time_var="t")
-    domain.add("x", [-1.0, 1.0], 256)
-    domain.add("t", [0.0, 1.0], 100)
-    domain.generate_collocation_points(scaled(args, 10_000, 1_000), seed=0)
+    solver = zoo.build_solver(ENTRY, spec=spec)
+    fit_resumable(solver, quick=args.quick, tf_iter=spec.budget.adam,
+                  newton_iter=spec.budget.lbfgs,
+                  resample_every=args.resample)
 
-    bcs = [IC(domain, [lambda x: -np.sin(np.pi * x)], var=[["x"]]),
-           dirichletBC(domain, val=0.0, var="x", target="upper"),
-           dirichletBC(domain, val=0.0, var="x", target="lower")]
-
-    def f_model(u, x, t):
-        u_x, u_t = grad(u, "x"), grad(u, "t")
-        u_xx = grad(u_x, "x")
-        return u_t(x, t) + u(x, t) * u_x(x, t) - (0.01 / np.pi) * u_xx(x, t)
-
-    widths = [20] * 8 if not args.quick else [20] * 4
-    solver = CollocationSolverND()
-    solver.compile([2, *widths, 1], f_model, domain, bcs)
-    fit_resumable(solver, quick=args.quick, tf_iter=scaled(args, 10_000, 200),
-               newton_iter=scaled(args, 10_000, 100),
-               resample_every=args.resample)
-
-    x, t, usol = burgers_solution()
-    Xg = np.stack(np.meshgrid(x, t, indexing="ij"), -1).reshape(-1, 2)
-    u_pred, _ = solver.predict(Xg, best_model=True)
-    err = tdq.find_L2_error(u_pred, usol.reshape(-1, 1))
+    ref = ENTRY.reference(spec)
+    u_pred, _ = solver.predict(ref.X, best_model=True)
+    err = tdq.find_L2_error(ref.compare(u_pred), ref.u)
     print(f"Error u: {err:e}")
 
     if args.plot:
+        x, t, usol = burgers_solution()
         tdq.plotting.plot_solution_domain1D(
             solver, [x, t], ub=[1.0, 1.0], lb=[-1.0, 0.0], Exact_u=usol,
             save_path=f"{args.plot}/burgers.png", best_model=True)

@@ -3,58 +3,30 @@
 
 ``i h_t + 0.5 h_xx + |h|^2 h = 0`` on x in [-5, 5], t in [0, pi/2], with
 ``h(x, 0) = 2 sech(x)`` and periodic BCs (value + first derivative) in x.
-The network has TWO outputs — h = u + iv — exercising the coupled-system
-surface the reference supports (tuple residuals + per-output ICs,
-``models.py:189-191``) but ships no example of.  Truth: the split-step
-Fourier spectral solution in ``tensordiffeq_tpu.exact``.
+The network has TWO outputs — h = u + iv — and the tuple-returning
+``f_model`` adopts the fused minimax engine as a TWO-equation system
+(PR 16; watch for ``[fuse] minimax engine adopted`` at compile): both
+residuals, their per-equation λ channels, and every cotangent reduce in
+one fusion, so the coupled benchmark trains on the same fast path as the
+scalar examples.
 
-Since PR 16 the tuple-returning ``f_model`` adopts the fused minimax
-engine as a TWO-equation system (watch for ``[fuse] minimax engine
-adopted`` at compile): both residuals, their per-equation λ channels,
-and every cotangent reduce in one fusion (``ops/pallas_minimax``), so
-the coupled benchmark trains on the same fast path as the scalar
-examples — the measured step-time reduction is in ``bench.py --mode
-minimax`` (``system`` block) and a convergence row in CONVERGENCE.md.
+The problem declaration lives in the zoo registry
+(``tensordiffeq_tpu.zoo``, entry ``schrodinger``) — this script resolves
+its config from there; truth is the split-step Fourier spectral solution
+in ``tensordiffeq_tpu.exact``.
 """
+
+import dataclasses
 
 import numpy as np
 
-from _common import example_args, scaled, fit_resumable
+from _common import example_args, fit_resumable, zoo_spec
 
 import tensordiffeq_tpu as tdq
-from tensordiffeq_tpu import (CollocationSolverND, DomainND, IC, grad,
-                              periodicBC)
+from tensordiffeq_tpu import zoo
 from tensordiffeq_tpu.exact import schrodinger_solution
 
-
-def build_problem(n_f: int, nx: int = 256, nt: int = 201, seed: int = 0):
-    t_final = float(np.pi / 2)
-    domain = DomainND(["x", "t"], time_var="t")
-    domain.add("x", [-5.0, 5.0], nx)
-    domain.add("t", [0.0, t_final], nt)
-    domain.generate_collocation_points(n_f, seed=seed)
-
-    # h(x, 0) = 2 sech(x):  u = 2 sech(x), v = 0
-    ics = IC(domain,
-             [lambda x: 2.0 / np.cosh(x), lambda x: 0.0 * x],
-             var=[["x"], ["x"]])
-
-    def deriv_model(u, x, t):
-        return (u[0](x, t), u[1](x, t),
-                grad(u[0], "x")(x, t), grad(u[1], "x")(x, t))
-
-    per = periodicBC(domain, ["x"], [deriv_model])
-
-    def f_model(u, x, t):
-        uv, vv = u[0](x, t), u[1](x, t)
-        sq = uv ** 2 + vv ** 2
-        f_u = grad(u[0], "t")(x, t) + 0.5 * grad(grad(u[1], "x"), "x")(x, t) \
-            + sq * vv
-        f_v = grad(u[1], "t")(x, t) - 0.5 * grad(grad(u[0], "x"), "x")(x, t) \
-            - sq * uv
-        return f_u, f_v
-
-    return domain, [ics, per], f_model
+ENTRY = zoo.get("schrodinger")
 
 
 def evaluate(solver, args, name):
@@ -76,20 +48,22 @@ def evaluate(solver, args, name):
 def main():
     args = example_args(
         "Nonlinear Schrödinger 2-output PINN",
-        nf=(0, "override N_f (0 = config default)"),
-        adam=(0, "override Adam iters (0 = config default)"),
-        newton=(0, "override L-BFGS iters (0 = config default)"),
-        width=(0, "override hidden width (0 = config default)"))
-    n_f = args.nf or scaled(args, 20_000, 2_000)
-    nx, nt = (256, 201) if not args.quick else (64, 21)
-    domain, bcs, f_model = build_problem(n_f, nx=nx, nt=nt)
-    w = args.width or (100 if not args.quick else 32)
-    widths = [w] * (4 if not args.quick else 2)
+        nf=(0, "override N_f (0 = zoo-entry default)"),
+        adam=(0, "override Adam iters (0 = zoo-entry default)"),
+        newton=(0, "override L-BFGS iters (0 = zoo-entry default)"),
+        width=(0, "override hidden width (0 = zoo-entry default)"))
+    spec = zoo_spec(ENTRY, args.quick, n_f=args.nf)
+    if args.width:
+        spec = dataclasses.replace(
+            spec, widths=(args.width,) * len(spec.widths))
+    if args.adam or args.newton:
+        spec = dataclasses.replace(
+            spec, budget=zoo.Budget(args.adam or spec.budget.adam,
+                                    args.newton or spec.budget.lbfgs))
 
-    solver = CollocationSolverND()
-    solver.compile([2, *widths, 2], f_model, domain, bcs)
-    fit_resumable(solver, quick=args.quick, tf_iter=args.adam or scaled(args, 10_000, 200),
-               newton_iter=args.newton or scaled(args, 10_000, 100))
+    solver = zoo.build_solver(ENTRY, spec=spec)
+    fit_resumable(solver, quick=args.quick, tf_iter=spec.budget.adam,
+                  newton_iter=spec.budget.lbfgs)
     return evaluate(solver, args, "schrodinger")
 
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Render CONVERGENCE.md's zoo-scorecard section from a scorecard JSON.
+
+The prose history above the markers is hand-written and stays; the table
+between ``<!-- zoo-scorecard:begin -->`` / ``<!-- zoo-scorecard:end -->``
+is GENERATED from the machine-readable scorecard (``SCORECARD.json``, or
+any ``bench.py --zoo`` payload passed as argv[1]) so the results table
+can never drift from what the harness measured.
+
+    python scripts/convergence_table.py            # splice SCORECARD.json
+    python scripts/convergence_table.py card.json  # splice another card
+    python scripts/convergence_table.py --stdout   # print, don't write
+"""
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+BEGIN = "<!-- zoo-scorecard:begin -->"
+END = "<!-- zoo-scorecard:end -->"
+
+
+def _fmt_metric(v):
+    return "—" if v is None else f"{v:.3g}"
+
+
+def _arm_cell(arm, gate_kind):
+    if arm is None:
+        return "—"
+    metric = arm.get("rel_l2_final" if gate_kind == "rel_l2"
+                     else "residual_final")
+    if arm.get("gated"):
+        return (f"**✓** @ {arm['steps_to_gate']} steps "
+                f"({_fmt_metric(metric)})")
+    return f"✗ {_fmt_metric(metric)}"
+
+
+def render(doc) -> str:
+    from tensordiffeq_tpu.zoo import scorecard_of
+
+    card = scorecard_of(doc)
+    backend = doc.get("backend", "cpu")
+    lines = [
+        BEGIN,
+        "",
+        "## Zoo scorecard (generated — do not hand-edit this section)",
+        "",
+        f"Measured by `bench.py --zoo` at the registry's declared "
+        f"`{card['size']}` budgets on `{backend}`; regenerate with "
+        "`python scripts/convergence_table.py`.  Per entry, the three "
+        "adaptive-collocation arms race to the entry's declared gate "
+        "(rel-L2 against the reference, or held-out RMS residual for "
+        "residual-only entries); ✓ cells show the cumulative optimizer "
+        "step from which the gate was reached AND HELD through the end "
+        "of the budget (transient dips don't gate), and every cell "
+        "carries the final metric.  The CI diff gate "
+        "(`bench.py --zoo-diff`) holds "
+        "future runs to the ✓ cells recorded here.",
+        "",
+        "| Entry | Engine | Budget (Adam+L-BFGS) | Gate | fixed | "
+        "pool | ascent |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for eid, e in sorted(card["entries"].items()):
+        gate = e["gate"]
+        gate_cell = (f"rel-L2 ≤ {gate['value']:g}"
+                     if gate["kind"] == "rel_l2"
+                     else f"RMS residual ≤ {gate['value']:g}")
+        name = f"**{eid}**" if e.get("system") else eid
+        if e.get("system"):
+            name += f" ({e['n_components']}-comp system)"
+        lines.append(
+            f"| {name} | `{e['engine']}` "
+            f"| {e['budget']['adam']}+{e['budget']['lbfgs']} "
+            f"| {gate_cell} "
+            f"| {_arm_cell(e['arms'].get('fixed'), gate['kind'])} "
+            f"| {_arm_cell(e['arms'].get('pool'), gate['kind'])} "
+            f"| {_arm_cell(e['arms'].get('ascent'), gate['kind'])} |")
+    lines += ["", END]
+    return "\n".join(lines)
+
+
+def splice(text: str, section: str) -> str:
+    if BEGIN in text and END in text:
+        head, rest = text.split(BEGIN, 1)
+        _, tail = rest.split(END, 1)
+        return head + section + tail
+    return text.rstrip("\n") + "\n\n" + section + "\n"
+
+
+def main(argv):
+    to_stdout = "--stdout" in argv
+    argv = [a for a in argv if a != "--stdout"]
+    card_path = argv[0] if argv else os.path.join(ROOT, "SCORECARD.json")
+    with open(card_path) as fh:
+        doc = json.load(fh)
+    section = render(doc)
+    if to_stdout:
+        print(section)
+        return
+    conv = os.path.join(ROOT, "CONVERGENCE.md")
+    with open(conv) as fh:
+        text = fh.read()
+    with open(conv, "w") as fh:
+        fh.write(splice(text, section))
+    print(f"spliced zoo scorecard ({len(doc.get('scorecard', doc).get('entries', {}))} "
+          f"entries) from {os.path.relpath(card_path, ROOT)} into "
+          f"CONVERGENCE.md")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

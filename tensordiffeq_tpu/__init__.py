@@ -32,7 +32,7 @@ from . import boundaries, checkpoint, domains, exact, helpers  # noqa: F401
 from . import networks, ops, output  # noqa: F401
 from . import parallel, plotting, profiling, sampling, telemetry  # noqa: F401
 from . import resilience, training, utils  # noqa: F401
-from . import factory, fleet, models, serving  # noqa: F401
+from . import factory, fleet, models, serving, zoo  # noqa: F401
 from .boundaries import (  # noqa: F401
     BC, IC, FunctionDirichletBC, FunctionNeumannBC, dirichletBC, periodicBC)
 from .domains import DomainND  # noqa: F401

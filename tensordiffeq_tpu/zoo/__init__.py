@@ -1,0 +1,30 @@
+"""The PDE zoo: declarative benchmark problems + the convergence-gated
+scorecard (PR 17).
+
+Importing this package registers the seed entries (Burgers, SA
+Allen-Cahn, Schrödinger, reaction-diffusion, Taylor-Green/Navier-Stokes,
+3D heat, stiff convection, Burgers assimilation, residual-only 2D
+Burgers system) and exposes the registry/harness surface.  The example
+scripts resolve their configs from here — the registry is the single
+source of truth — and ``bench.py --zoo`` turns it into the scorecard CI
+diffs against ``SCORECARD.json``.
+"""
+
+from .registry import (Budget, Reference, SizeSpec,  # noqa: F401
+                       ZooEntry, ZooProblem, ZooValidationError,
+                       build_solver, engine_label, get, ids, register)
+# NB: import the seed-entry submodule BEFORE binding registry.entries —
+# `from . import entries` resolves an existing package attribute instead
+# of the submodule, and the zoo would silently register nothing.
+from . import entries as _entries  # noqa: F401  (registers the seed zoo)
+from .registry import entries  # noqa: F401
+from .scorecard import (ARMS, SCHEMA_VERSION,  # noqa: F401
+                        diff_scorecards, race_entry, run_scorecard,
+                        scorecard_of)
+
+__all__ = [
+    "ARMS", "Budget", "Reference", "SCHEMA_VERSION", "SizeSpec",
+    "ZooEntry", "ZooProblem", "ZooValidationError", "build_solver",
+    "diff_scorecards", "engine_label", "entries", "get", "ids",
+    "race_entry", "register", "run_scorecard", "scorecard_of",
+]

@@ -104,6 +104,24 @@ def test_factory_surface():
     assert not missing, f"factory surface missing: {missing}"
 
 
+# the PDE-zoo surface (docs/api.md "PDE zoo" section, PR 17)
+ZOO = ["ZooEntry", "ZooProblem", "ZooValidationError", "Budget",
+       "SizeSpec", "Reference", "register", "get", "ids", "entries",
+       "build_solver", "engine_label", "race_entry", "run_scorecard",
+       "diff_scorecards", "scorecard_of", "ARMS", "SCHEMA_VERSION"]
+
+
+def test_zoo_surface():
+    missing = [f"tdq.zoo.{n}" for n in ZOO if not hasattr(tdq.zoo, n)]
+    assert not missing, f"zoo surface missing: {missing}"
+    # the three raced arms are themselves API: the scorecard schema,
+    # SCORECARD.json, and the CONVERGENCE.md table all key on them
+    assert list(tdq.zoo.ARMS) == ["fixed", "pool", "ascent"]
+    # zoo.entries must be the registry accessor, not the seed submodule
+    # (the import-order shadow build regression this pins)
+    assert callable(tdq.zoo.entries) and tdq.zoo.entries()
+
+
 def test_elastic_surface():
     from tensordiffeq_tpu import parallel, resilience
     missing = [f"resilience.{n}" for n in ELASTIC_RESILIENCE

@@ -1,0 +1,300 @@
+"""The PDE zoo: registry validation, scorecard contract, CI diff gate.
+
+The expensive piece — a real ``bench.py --zoo`` run — follows the
+module-scoped overlapped-Popen discipline of ``test_bench_harness.py``:
+the subprocess starts when the first test of this module runs, cooks
+behind the in-process tests, and is joined by
+``test_zoo_scorecard_json_contract`` — deliberately the LAST test in the
+file (tier-1 wall discipline: new subprocess work hides behind existing
+waits, it does not add to them).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensordiffeq_tpu import zoo  # noqa: E402
+from tensordiffeq_tpu.zoo import (Budget, Reference, SizeSpec,  # noqa: E402
+                                  ZooEntry, ZooProblem, ZooValidationError)
+
+# two entries — one scalar, one true 2-component system — at a hard
+# phase cap: the contract under test is the scorecard JSON (schema,
+# three arms, engine disclosure), not convergence
+_ZOO_SUBSET = "burgers,schrodinger"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def zoo_bench_proc():
+    env = dict(os.environ, BENCH_FAST="1", JAX_PLATFORMS="cpu",
+               TDQ_PLATFORM="cpu", PALLAS_AXON_POOL_IPS="",
+               BENCH_ZOO_ENTRIES=_ZOO_SUBSET, BENCH_ZOO_CAP="25")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+         "--zoo", "--force-cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    yield proc
+    if proc.poll() is None:  # join test skipped/failed early: reap it
+        proc.kill()
+        proc.communicate()
+
+
+# --------------------------------------------------------------------------- #
+# registry declarations
+# --------------------------------------------------------------------------- #
+def _spec(**kw):
+    base = dict(n_f=64, widths=(4,), grid=(8, 3),
+                budget=Budget(10, 5), gate_rel_l2=0.5)
+    base.update(kw)
+    return SizeSpec(**base)
+
+
+def _entry(**kw):
+    base = dict(id="tmp-entry", title="t", equation="e", n_inputs=2,
+                n_components=1, build=lambda spec: None,
+                reference=lambda spec: None,
+                sizes={"micro": _spec(), "full": _spec()})
+    base.update(kw)
+    return ZooEntry(**base)
+
+
+def test_registry_seeded_with_declared_breadth():
+    # the acceptance floor: >= 8 entries, >= 3 true multi-component
+    # systems, every entry declaring micro+full with a budget and a gate
+    ids = zoo.ids()
+    assert len(ids) >= 8
+    assert len(ids) == len(set(ids))
+    systems = [e for e in zoo.entries() if e.system]
+    assert len(systems) >= 3
+    for e in zoo.entries():
+        for size in ("micro", "full"):
+            s = e.spec(size)
+            assert s.budget.total > 0
+            assert e.gate(size) > 0
+    # the breadth ROADMAP item 1 names: 3D, stiff, inverse
+    assert any(e.n_inputs >= 4 for e in zoo.entries())
+    assert any("stiff" in e.tags for e in zoo.entries())
+    assert any(e.inverse for e in zoo.entries())
+
+
+def test_register_rejects_duplicate_and_bad_ids():
+    with pytest.raises(ZooValidationError, match="already registered"):
+        zoo.register(_entry(id="burgers"))
+    with pytest.raises(ZooValidationError, match="kebab-case"):
+        zoo.register(_entry(id="Not_Kebab"))
+
+
+def test_register_rejects_missing_size_and_bad_budget():
+    with pytest.raises(ZooValidationError, match="missing declared"):
+        zoo.register(_entry(sizes={"micro": _spec()}))
+    with pytest.raises(ZooValidationError, match="budget"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(budget=Budget(0, 0)), "full": _spec()}))
+    with pytest.raises(ZooValidationError, match="budget"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(budget=Budget(-5, 10)), "full": _spec()}))
+
+
+def test_register_rejects_bad_gates():
+    # no gate at all
+    with pytest.raises(ZooValidationError, match="exactly one"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(gate_rel_l2=None), "full": _spec()}))
+    # both gate kinds at once
+    with pytest.raises(ZooValidationError, match="exactly one"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(gate_residual=0.1), "full": _spec()}))
+    # rel-L2 above 1.0 is met by predicting zero
+    with pytest.raises(ZooValidationError, match="predicting zero"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(gate_rel_l2=1.5), "full": _spec()}))
+    # gate kind must match the reference kind
+    with pytest.raises(ZooValidationError, match="residual-only"):
+        zoo.register(_entry(reference=None))
+    with pytest.raises(ZooValidationError, match="rel-L2"):
+        zoo.register(_entry(sizes={
+            "micro": _spec(gate_rel_l2=None, gate_residual=0.1),
+            "full": _spec()}))
+
+
+def test_build_solver_rejects_residual_arity_drift():
+    # the builder produces a 1-output network for a declared 2-component
+    # system: build_solver must refuse before compile
+    def bad_build(spec):
+        real = zoo.get("burgers")
+        return real.build(real.spec("micro"))  # layer_sizes end in 1
+
+    entry = _entry(id="bad-arity", n_components=2, build=bad_build,
+                   sizes={"micro": _spec(), "full": _spec()})
+    with pytest.raises(ZooValidationError, match="n_components=2"):
+        zoo.build_solver(entry, "micro")
+
+
+def test_unknown_entry_and_unknown_size_are_typed_errors():
+    with pytest.raises(ZooValidationError, match="unknown zoo entry"):
+        zoo.get("no-such-entry")
+    with pytest.raises(ZooValidationError, match="declares no"):
+        zoo.get("burgers").spec("nano")
+    assert ZooValidationError.trace_id is None  # raise-discipline contract
+
+
+@pytest.mark.slow
+def test_every_entry_compiles_at_micro_size():
+    """Every declared entry builds and compiles at its micro size, and
+    every multi-component system adopts the fused system minimax engine
+    with the declared equation count (minutes on CPU -> slow tier)."""
+    for e in zoo.entries():
+        solver = zoo.build_solver(e, "micro")
+        label = zoo.engine_label(solver)
+        if e.system:
+            assert label.startswith("fused-minimax"), (e.id, label)
+            assert int(solver._minimax_n_eq) == e.n_components
+        assert solver._residual_jit is not None
+
+
+# --------------------------------------------------------------------------- #
+# diff gate
+# --------------------------------------------------------------------------- #
+def _card(gated=True, engine="fused-minimax-xla", cap=None):
+    card = {"schema": 1, "size": "micro", "arms": list(zoo.ARMS),
+            "entries": {"burgers": {
+                "system": False, "engine": engine,
+                "gate": {"kind": "rel_l2", "value": 0.2},
+                "budget": {"adam": 100, "lbfgs": 50},
+                "arms": {"fixed": {"gated": gated, "steps_to_gate": 50,
+                                   "rel_l2_final": 0.1}}}}}
+    if cap is not None:
+        card["budget_cap"] = cap
+    return card
+
+
+def test_diff_gate_lost_is_a_regression():
+    v = zoo.diff_scorecards(_card(gated=True), _card(gated=False))
+    assert not v["ok"]
+    assert v["regressions"][0]["kind"] == "gate-lost"
+    # ...and a run matching the baseline verdict is clean
+    assert zoo.diff_scorecards(_card(), _card())["ok"]
+    # baseline-ungated arms can never regress
+    assert zoo.diff_scorecards(_card(gated=False), _card(gated=False))["ok"]
+
+
+def test_diff_engine_downgrade_is_a_regression():
+    v = zoo.diff_scorecards(_card(), _card(engine="generic"))
+    assert not v["ok"]
+    assert v["regressions"][0]["kind"] == "engine-downgrade"
+
+
+def test_diff_subset_runs_skip_not_regress():
+    current = _card()
+    current["entries"] = {}
+    v = zoo.diff_scorecards(_card(), current)
+    assert v["ok"] and v["skipped"] == ["burgers"]
+
+
+def test_diff_capped_run_skips_gate_comparison():
+    v = zoo.diff_scorecards(_card(gated=True), _card(gated=False, cap=25))
+    assert v["ok"] and v["budget_capped"]
+    # but an engine downgrade still regresses, capped or not
+    v = zoo.diff_scorecards(_card(), _card(engine="generic", cap=25))
+    assert not v["ok"]
+
+
+def test_zoo_diff_cli_exits_3_on_regression(tmp_path):
+    """The CI gate end-to-end: perturb a gated cell in a copy of the
+    baseline -> ``bench.py --zoo-diff`` prints a verdict and exits 3;
+    the unperturbed copy exits 0."""
+    base = os.path.join(REPO, "SCORECARD.json")
+    with open(base) as fh:
+        card = json.load(fh)
+    ok_path = tmp_path / "same.json"
+    ok_path.write_text(json.dumps(card))
+
+    bad = json.loads(json.dumps(card))
+    entries = zoo.scorecard_of(bad)["entries"]
+    flipped = 0
+    for e in entries.values():
+        for arm in e["arms"].values():
+            if arm.get("gated"):
+                arm["gated"] = False
+                flipped += 1
+    assert flipped, "baseline SCORECARD.json must contain gated cells"
+    bad_path = tmp_path / "perturbed.json"
+    bad_path.write_text(json.dumps(bad))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu")
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--zoo-diff"]
+    r_bad = subprocess.run(cmd + [str(bad_path)], capture_output=True,
+                           text=True, cwd=REPO, env=env, timeout=300)
+    assert r_bad.returncode == 3, (r_bad.stdout, r_bad.stderr)
+    verdict = json.loads(r_bad.stdout.strip().splitlines()[-1])
+    assert not verdict["ok"] and len(verdict["regressions"]) == flipped
+
+    r_ok = subprocess.run(cmd + [str(ok_path)], capture_output=True,
+                          text=True, cwd=REPO, env=env, timeout=300)
+    assert r_ok.returncode == 0, (r_ok.stdout, r_ok.stderr)
+    assert json.loads(r_ok.stdout.strip().splitlines()[-1])["ok"]
+
+
+# --------------------------------------------------------------------------- #
+# example <-> registry coherence (satellite: one source of truth)
+# --------------------------------------------------------------------------- #
+def test_examples_resolve_config_from_registry():
+    ex = os.path.join(REPO, "examples")
+    for script, eid in [("burgers.py", "burgers"),
+                        ("schrodinger.py", "schrodinger"),
+                        ("ac_sa.py", "allen-cahn-sa")]:
+        with open(os.path.join(ex, script)) as fh:
+            src = fh.read()
+        assert f'zoo.get("{eid}")' in src, \
+            f"{script} no longer resolves its config from the zoo registry"
+        assert "zoo_spec" in src
+
+
+def test_spec_override_is_validated():
+    entry = zoo.get("burgers")
+    bad = dataclasses.replace(entry.spec("micro"), n_f=-1)
+    with pytest.raises(ZooValidationError, match="n_f"):
+        zoo.build_solver(entry, spec=bad)
+
+
+# --------------------------------------------------------------------------- #
+# the scorecard contract — joins the module Popen, keep LAST in the file
+# --------------------------------------------------------------------------- #
+def test_zoo_scorecard_json_contract(zoo_bench_proc):
+    out, err = zoo_bench_proc.communicate(timeout=560)
+    assert zoo_bench_proc.returncode == 0, err[-2000:]
+    payload = json.loads(out.strip().splitlines()[-1])
+
+    assert payload["unit"] == "entries"
+    assert payload["entries_run"] == 2
+    assert payload["backend"] == "cpu"
+    card = payload["scorecard"]
+    assert card["schema"] == zoo.SCHEMA_VERSION
+    assert card["budget_cap"] == 25  # capped runs must disclose it
+    assert card["arms"] == ["fixed", "pool", "ascent"]
+    assert set(card["entries"]) == set(_ZOO_SUBSET.split(","))
+
+    for eid, e in card["entries"].items():
+        assert set(e["arms"]) == {"fixed", "pool", "ascent"}
+        assert e["gate"]["kind"] == "rel_l2"
+        assert "budget_capped" in e
+        for arm in e["arms"].values():
+            # the declared per-arm scorecard row, in full
+            for key in ("gated", "steps_to_gate", "rel_l2_final",
+                        "wall_s", "redraws", "stall_p50_s",
+                        "flops_per_step", "flops_basis"):
+                assert key in arm, (eid, key)
+            assert arm["rel_l2_final"] is not None  # eval really fired
+            assert arm["flops_basis"] is not None
+    # the 2-component system rode the fused system minimax engine
+    assert card["entries"]["schrodinger"]["engine"].startswith(
+        "fused-minimax")
+    assert card["entries"]["schrodinger"]["n_components"] == 2

@@ -1379,6 +1379,215 @@ def bench_fleet(n_f, nx, nt, widths, on_phase=None):
 
 
 # --------------------------------------------------------------------------- #
+# --closedloop: one drift -> retrain -> hot-swap cycle, end to end
+# --------------------------------------------------------------------------- #
+def closedloop_partial(payload):
+    """The salvageable detection-phase line for --closedloop (same rule
+    as fleet_partial): if the retrain/swap phase dies, the drift-detection
+    measurement already taken survives as a REAL headline."""
+    return dict(
+        payload,
+        metric="closed-loop drift detection latency "
+               "(retrain/swap phase incomplete)",
+        value=payload["detection"]["wall_s"],
+        unit="s (drift injection -> SLO trip)",
+        note="retrain/swap phase did not complete; detection "
+             "measurement only")
+
+
+def bench_closedloop(n_f, nx, nt, widths, on_phase=None):
+    """One autonomous closed-loop cycle (ROADMAP item 4), measured end to
+    end: a small Allen-Cahn coefficient family is trained, exported and
+    served through a :class:`~tensordiffeq_tpu.fleet.FleetRouter`; the
+    served params are then perturbed in place (the drift is applied
+    directly — no chaos scope, so the payload stays promotable) and the
+    :class:`~tensordiffeq_tpu.fleet.DriftMonitor` must detect it from
+    shadow-sampled live traffic; the
+    :class:`~tensordiffeq_tpu.fleet.RetrainController` retrains the
+    family warm-started from the drifted served params and hot-swaps
+    every tenant behind a canary gate.
+
+    The headline is the loop's MTTR — wall seconds from drift injection
+    to every tenant cut over — decomposed into the ISSUE's four
+    measurements: detection latency (queries + wall from injection to
+    SLO trip), retrain wall, swap cutover stall p50 (the only pause a
+    waiter can observe), and post-swap residual improvement (drifted /
+    post-swap probe residual, >1 means the loop healed the fleet).
+    ``request_time_compiles`` proves the cutover compiled nothing at
+    request time.  ``on_phase(payload)`` streams a salvageable line
+    after the detection phase."""
+    import shutil
+    import tempfile
+
+    import jax
+    from tensordiffeq_tpu import (IC, DomainND, SurrogateFactory, fleet,
+                                  grad, periodicBC)
+    from tensordiffeq_tpu.telemetry import default_registry
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_members = 2 if fast else 4
+    min_bucket, max_bucket = (64, 256) if fast else (256, 4096)
+    pre_iters = 60 if fast else 600
+    retrain_iters = 60 if fast else 600
+    chunk = 20 if fast else 100
+    drift_scale = 0.8
+    thetas = [0.0009 + 0.0002 * m for m in range(n_members)]
+
+    domain = DomainND(["x", "t"], time_var="t")
+    domain.add("x", [-1.0, 1.0], nx)
+    domain.add("t", [0.0, 1.0], nt)
+    domain.generate_collocation_points(min(n_f, 2048 if fast else 10_000),
+                                       seed=0)
+
+    def func_ic(x):
+        return x ** 2 * np.cos(np.pi * x)
+
+    def deriv_model(u, x, t):
+        return u(x, t), grad(u, "x")(x, t)
+
+    bcs = [IC(domain, [func_ic], var=[["x"]]),
+           periodicBC(domain, ["x"], [deriv_model])]
+
+    def f_model(u, x, t, th):
+        u_xx = grad(grad(u, "x"), "x")
+        u_t = grad(u, "t")
+        uv = u(x, t)
+        return u_t(x, t) - th * u_xx(x, t) + 5.0 * uv ** 3 - 5.0 * uv
+
+    def build_factory(init_params=None):
+        return SurrogateFactory(widths_to_layers(widths), f_model, domain,
+                                bcs, thetas, init_params=init_params,
+                                verbose=False)
+
+    def widths_to_layers(ws):
+        return [2] + list(ws) + [1]
+
+    rng = np.random.RandomState(0)
+
+    def draw(n):
+        return np.stack([rng.uniform(-1.0, 1.0, n),
+                         rng.uniform(0.0, 1.0, n)],
+                        -1).astype(np.float32)
+
+    reg = default_registry()
+
+    def compile_count():
+        return sum(v for k, v in reg.as_dict()["counters"].items()
+                   if k.startswith("serving.engine.compiles"))
+
+    work = tempfile.mkdtemp(prefix="tdq_closedloop_bench_")
+    try:
+        # -- v1: train, export, serve, monitor --------------------------- #
+        factory = build_factory()
+        factory.fit(tf_iter=pre_iters, chunk=chunk)
+        v1 = os.path.join(work, "v1")
+        factory.export_family(v1, min_bucket=min_bucket,
+                              max_bucket=max_bucket)
+        router = fleet.FleetRouter(max_loaded=n_members + 1)
+        policy = fleet.TenantPolicy(min_bucket=min_bucket,
+                                    max_bucket=max_bucket,
+                                    max_batch=min(1024, max_bucket),
+                                    max_latency_s=0.005)
+        members = router.register_family(
+            v1, policy=policy, prefix="m",
+            f_models={m: factory.member_f_model(m)
+                      for m in range(n_members)})
+        monitor = fleet.DriftMonitor(router, sample_fraction=0.5,
+                                     window=2, seed=0)
+        probe = draw(min_bucket)
+        for tenant in members.values():
+            router.load(tenant)
+            monitor.attach(tenant, probe)
+
+        # -- drift injection + detection --------------------------------- #
+        # perturb every tenant's SERVED params in place (the engine reads
+        # them at call time) and serve traffic until the monitor trips
+        for tenant in members.values():
+            lt = router.load(tenant)
+            lt.surrogate.params = jax.tree_util.tree_map(
+                lambda a: a * (1.0 + drift_scale), lt.surrogate.params)
+        drifted_res = float(np.mean([
+            np.mean(np.abs(np.asarray(
+                router.load(t).engine.residual(probe))))
+            for t in members.values()]))
+        t0 = time.time()
+        queries_to_trip = 0
+        while not monitor.tripped() and queries_to_trip < 500:
+            tenant = list(members.values())[
+                queries_to_trip % len(members)]
+            monitor.query(tenant, draw(int(rng.randint(1, 33))))
+            queries_to_trip += 1
+        detect_wall = time.time() - t0
+        payload = {
+            "metric": "closed-loop MTTR: drift injection -> every tenant "
+                      f"hot-swapped ({len(members)} tenants)",
+            "value": None, "unit": "s", "vs_baseline": None,
+            "tenants": len(members),
+            "detection": {
+                "wall_s": round(detect_wall, 4),
+                "queries_to_trip": queries_to_trip,
+                "tripped": list(monitor.tripped()),
+                "drift_level": max(
+                    monitor.drift(t) or 0.0 for t in members.values()),
+                "slo": monitor.evaluate()["objectives"]["residual_drift"],
+            },
+        }
+        log(f"[closedloop] drift tripped after {queries_to_trip} queries "
+            f"({detect_wall:.2f}s): level "
+            f"{payload['detection']['drift_level']:.1f}x")
+        if on_phase is not None:
+            on_phase(closedloop_partial(payload))
+
+        # -- retrain + hot-swap ------------------------------------------ #
+        controller = fleet.RetrainController(
+            router, monitor, build_factory, members,
+            retrain_iters=retrain_iters, chunk=chunk,
+            resample_every=0,  # disclosed: redraw compile excluded here
+            gate_ratio=10.0,   # permissive gate; improvement is REPORTED
+            export_kw=dict(min_bucket=min_bucket, max_bucket=max_bucket),
+            workdir=work, verbose=False)
+        cycle = controller.run_cycle()
+        pre = compile_count()
+        post_res = float(np.mean([
+            np.mean(np.abs(np.asarray(
+                router.load(t).engine.residual(probe))))
+            for t in members.values()]))
+        for tenant in members.values():  # post-swap serve: zero compiles
+            router.query(tenant, draw(16))
+        request_time_compiles = compile_count() - pre
+        stalls = sorted(v["cutover_stall_s"] for v in cycle["swapped"])
+        payload.update(
+            value=round(detect_wall + cycle["retrain_wall_s"]
+                        + sum(stalls), 3),
+            retrain={"wall_s": round(cycle["retrain_wall_s"], 3),
+                     "epochs": cycle["retrain_epochs"],
+                     "generations": cycle["generations"]},
+            swap={
+                "swapped": len(cycle["swapped"]),
+                "rolled_back": len(cycle["rolled_back"]),
+                "cutover_stall_p50_s": (
+                    round(stalls[len(stalls) // 2], 6) if stalls
+                    else None),
+                "request_time_compiles": request_time_compiles,
+            },
+            residual={"baseline": round(float(np.mean(
+                          [monitor.baseline(t)
+                           for t in members.values()])), 6),
+                      "drifted": round(drifted_res, 6),
+                      "post_swap": round(post_res, 6),
+                      "improvement": (round(drifted_res / post_res, 2)
+                                      if post_res > 0 else None)})
+        log(f"[closedloop] retrain {cycle['retrain_wall_s']:.1f}s, "
+            f"{len(cycle['swapped'])}/{len(members)} swapped, residual "
+            f"{drifted_res:.3e} -> {post_res:.3e} "
+            f"({payload['residual']['improvement']}x), "
+            f"{request_time_compiles} request-time compiles")
+        return payload
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------- #
 # --mode factory: family-of-M vmapped training vs the sequential baseline
 # --------------------------------------------------------------------------- #
 def bench_factory(n_f, nx, nt, widths, n_steps, n_members=64):
@@ -2031,6 +2240,20 @@ def worker_main(args):
             print(json.dumps(partial), flush=True)
 
         payload = bench_fleet(n_f, nx, nt, widths, on_phase=on_phase)
+    elif args.closedloop:
+        # stream per-phase like --fleet: a timeout in the retrain/swap
+        # phase still salvages the detection-latency measurement
+        def on_phase(partial):
+            import jax
+            partial.setdefault("backend", jax.default_backend())
+            partial.setdefault("device_kind", jax.devices()[0].device_kind)
+            print(json.dumps(partial), flush=True)
+
+        cl_nf = 256 if fast else 2048
+        cl_widths = [16, 16] if fast else [64, 64]
+        payload = bench_closedloop(cl_nf, 64 if fast else 512,
+                                   16 if fast else 201, cl_widths,
+                                   on_phase=on_phase)
     elif args.factory:
         f_nf = 256 if fast else 2048
         f_widths = [16, 16] if fast else [64, 64]
@@ -2640,6 +2863,13 @@ def main():
                          "throughput of a 64-member coefficient-sweep "
                          "family as ONE vmapped program vs the same "
                          "members trained sequentially")
+    ap.add_argument("--closedloop", action="store_true",
+                    help="the autonomous closed loop end to end: serve a "
+                         "surrogate family, inject parameter drift, and "
+                         "measure detection latency, retrain wall, swap "
+                         "cutover stall p50 and post-swap residual "
+                         "improvement through DriftMonitor / "
+                         "RetrainController / FleetRouter.hot_swap")
     ap.add_argument("--zoo", action="store_true",
                     help="PDE-zoo scorecard: race the three adaptive "
                          "arms (fixed LHS / pool top-k / PACMANN ascent) "
@@ -2660,7 +2890,8 @@ def main():
     ap.add_argument("--mode", choices=["default", "full", "engines",
                                        "precision", "minimax", "scale",
                                        "remat", "serving", "fleet",
-                                       "resample", "factory", "zoo"],
+                                       "resample", "factory",
+                                       "closedloop", "zoo"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
     ap.add_argument("--slo", metavar="TARGET",
@@ -2739,7 +2970,7 @@ def main():
     mode_flags = [f for f in ("--full", "--engines", "--precision",
                               "--minimax", "--scale", "--remat",
                               "--serving", "--fleet", "--resample",
-                              "--factory", "--zoo")
+                              "--factory", "--closedloop", "--zoo")
                   if getattr(args, f.lstrip("-"))]
 
     # Total wall budget.  The driver's no-flag invocation must finish well
@@ -2748,7 +2979,7 @@ def main():
     default_budget = {"default": 1140, "engines": 2400, "precision": 2400,
                       "minimax": 1800, "scale": 7200, "remat": 2400,
                       "serving": 1800, "fleet": 1800, "resample": 3600,
-                      "factory": 1800, "zoo": 7200,
+                      "factory": 1800, "closedloop": 1800, "zoo": 7200,
                       "full": 86400}[mode_name(mode_flags)]
     budget = float(os.environ.get("BENCH_BUDGET", default_budget))
     t_start = time.time()

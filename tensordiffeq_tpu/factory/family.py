@@ -236,6 +236,15 @@ class SurrogateFactory:
       seed: member ``m`` initializes its network with
         ``PRNGKey(seed + m)``, so ``CollocationSolverND(seed=seed + m)``
         is the member's matched-seed solo reference.
+      init_params: optional length-``M`` sequence of per-member param
+        pytrees that REPLACE the PRNG init — the neighborhood-retrain
+        warm start: the closed loop's
+        :class:`~tensordiffeq_tpu.fleet.RetrainController` passes the
+        LIVE members' served params here, so the retrain starts from
+        the drifting fleet's state instead of from scratch.  ``None``
+        entries fall back to that member's fresh ``PRNGKey(seed + m)``
+        draw (a member with no live tenant re-initializes); every given
+        tree must match the architecture's structure and shapes.
 
     The member loss is cross-checked against the template solver's loss
     at build time (value + gradients on a sample of the real collocation
@@ -253,10 +262,16 @@ class SurrogateFactory:
                  lr: float = 0.005, lr_weights: float = 0.005,
                  fused: Optional[bool] = None,
                  minimax: Optional[bool] = None,
-                 seed: int = 0, verbose: bool = True):
+                 seed: int = 0, init_params: Optional[Sequence] = None,
+                 verbose: bool = True):
         if len(thetas) < 1:
             raise ValueError("a family needs at least one member "
                              "(thetas is empty)")
+        if init_params is not None and len(init_params) != len(thetas):
+            raise ValueError(
+                f"init_params has {len(init_params)} entries for "
+                f"{len(thetas)} members; pass one per member (None for "
+                "a fresh PRNG init)")
         if Adaptive_type == 3:
             raise ValueError(
                 "NTK weighting (Adaptive_type=3) recomputes λ between "
@@ -305,9 +320,12 @@ class SurrogateFactory:
         ndim = domain.ndim
         members = []
         for m in range(self.n_members):
-            members.append(self.net.init(
+            fresh = self.net.init(
                 jax.random.PRNGKey(self.seed + m),
-                jnp.zeros((1, ndim), jnp.float32)))
+                jnp.zeros((1, ndim), jnp.float32))
+            given = None if init_params is None else init_params[m]
+            members.append(fresh if given is None
+                           else self._adopt_member_params(m, given, fresh))
         self.params = stack_members(members)
         self.lambdas = stack_members(
             [tree_copy(tpl.lambdas) for _ in range(self.n_members)])
@@ -351,6 +369,28 @@ class SurrogateFactory:
                   engine=self.engine)
 
     # ------------------------------------------------------------------ #
+    @staticmethod
+    def _adopt_member_params(m: int, given, fresh):
+        """Validate one ``init_params`` entry against the architecture's
+        own init (structure + leaf shapes) and adopt it as float32 — a
+        warm start from the wrong architecture must fail loudly at build
+        time, not as a shape error deep inside the vmapped step."""
+        g_leaves, g_def = jax.tree_util.tree_flatten(given)
+        f_leaves, f_def = jax.tree_util.tree_flatten(fresh)
+        if g_def != f_def:
+            raise ValueError(
+                f"init_params[{m}] does not match this architecture's "
+                f"param structure ({g_def} vs {f_def})")
+        out = []
+        for gl, fl in zip(g_leaves, f_leaves):
+            gl = jnp.asarray(gl, jnp.float32)
+            if gl.shape != fl.shape:
+                raise ValueError(
+                    f"init_params[{m}] leaf shape {gl.shape} does not "
+                    f"match the architecture's {fl.shape}")
+            out.append(gl)
+        return jax.tree_util.tree_unflatten(g_def, out)
+
     def _model_sharding(self, leaf_ndim: int):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -18,6 +18,14 @@ gauges, latency histograms, cache hit/miss/eviction counters — publish
 through the shared telemetry registry
 (:meth:`FleetRouter.autoscale_signals` distils them).
 
+The loop closes in :mod:`~tensordiffeq_tpu.fleet.closedloop`: a
+:class:`DriftMonitor` shadow-samples live traffic through the residual
+kind and trips the ``residual_drift`` SLO, a :class:`RetrainController`
+retrains the drifting θ neighborhood (factory warm-started from the live
+members' served params) under a supervisor loop with retry backoff, and
+:meth:`FleetRouter.hot_swap` flips each tenant to its canary-validated
+v2 member with zero downtime — or proves the rollback bit-identical.
+
 Typical flow::
 
     # train side, once per tenant:
@@ -37,6 +45,7 @@ Typical flow::
 
 from .admission import (PRIORITIES, AdmissionController,  # noqa: F401
                         AdmissionRejected)
+from .closedloop import DriftMonitor, RetrainController  # noqa: F401
 from .router import (FleetRouter, LoadedTenant,  # noqa: F401
                      TenantEvicted, TenantPolicy)
 from .warmstart import (AOT_SUBDIR, DEFAULT_KINDS,  # noqa: F401

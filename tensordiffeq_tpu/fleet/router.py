@@ -406,6 +406,147 @@ class FleetRouter:
         return tenant
 
     # ------------------------------------------------------------------ #
+    def hot_swap(self, tenant: str, artifact: str, *, f_model=None,
+                 net=None, probe_X=None, gate: Optional[float] = None,
+                 gate_ratio: float = 1.0) -> dict:
+        """Zero-downtime artifact swap with canary validation and
+        bit-validated rollback (the closed loop's cutover; see
+        :mod:`tensordiffeq_tpu.fleet.closedloop`).
+
+        The candidate artifact is restored through the checksum-validated
+        checkpoint path and warm-driven BESIDE the live tenant — the old
+        engine keeps serving while the new one loads and compiles
+        nothing at request time.  The canary then replays the pinned
+        ``probe_X`` on both engines: the candidate's mean absolute
+        residual must come in at or under the gate (``gate`` absolute
+        when given, else ``gate_ratio`` × the OLD engine's replayed
+        residual).  Only a passing candidate flips the route: the old
+        engine's pending batches are flushed (zero dropped or hung
+        waiters), the loaded-tenant entry is replaced in place (same LRU
+        slot — the flip is one dict assignment), and the registration
+        points at the new artifact so later reloads get v2.
+
+        A candidate that fails to restore (torn blob → checksum
+        mismatch) or fails its gate is REJECTED: the old engine keeps
+        serving, and the probe replay after rejection is byte-compared
+        against the pre-swap ``u`` snapshot (``bit_identical`` in the
+        verdict) — rollback is proven, not assumed.
+
+        Returns the verdict dict: ``swapped``, ``reason``,
+        ``old_residual`` / ``new_residual`` / ``gate``,
+        ``cutover_stall_s`` (flip-time flush stall; the only pause any
+        waiter can observe), ``bit_identical`` (rejections only) and the
+        candidate's warm-start report."""
+        reg = self._reg(tenant)
+        old = self.load(tenant)
+        verdict: dict = {"tenant": str(tenant), "swapped": False,
+                         "artifact": str(artifact)}
+        probe = (None if probe_X is None
+                 else np.atleast_2d(np.asarray(probe_X, np.float32)))
+        u_before = (None if probe is None
+                    else np.asarray(old.engine.u(probe)).tobytes())
+
+        t0 = self._clock()
+        try:
+            sur = Surrogate.load(str(artifact), f_model=f_model, net=net)
+            scope = self._registry.scope(tenant=tenant)
+            engine = sur.engine(min_bucket=reg.policy.min_bucket,
+                                max_bucket=reg.policy.max_bucket,
+                                shard=reg.policy.shard, registry=scope)
+            warm: dict = {}
+            if reg.policy.warm_start:
+                warm = warm_start(engine, kinds=reg.policy.warm_kinds,
+                                  tenant=tenant, registry=self._registry,
+                                  max_drive_bucket=reg.policy.max_batch)
+        except Exception as e:
+            # torn/corrupt candidate: the checkpoint checksum (or the
+            # engine build) refused it — the old engine never stopped
+            self._reject(tenant, old, probe, u_before, verdict,
+                         reason="artifact_rejected",
+                         detail=f"{type(e).__name__}: {e}")
+            return verdict
+        verdict["warm"] = warm
+        verdict["candidate_load_s"] = self._clock() - t0
+
+        if probe is not None:
+            old_res = float(np.mean(np.abs(
+                np.asarray(old.engine.residual(probe)))))
+            new_res = float(np.mean(np.abs(
+                np.asarray(engine.residual(probe)))))
+            g = float(gate) if gate is not None else gate_ratio * old_res
+            verdict.update(old_residual=old_res, new_residual=new_res,
+                           gate=g)
+            if not np.isfinite(new_res) or new_res > g:
+                self._registry.counter("fleet.canary.rejected",
+                                       tenant=tenant).inc()
+                log_event("closedloop",
+                          f"CANARY rejected tenant={tenant}: candidate "
+                          f"|residual| {new_res:.3e} over gate {g:.3e} "
+                          f"(old engine replays {old_res:.3e})",
+                          level="warning", verbose=False, event="canary",
+                          tenant=str(tenant), passed=False,
+                          old_residual=old_res, new_residual=new_res,
+                          gate=g)
+                self._reject(tenant, old, probe, u_before, verdict,
+                             reason="canary_regressed")
+                return verdict
+            self._registry.counter("fleet.canary.passed",
+                                   tenant=tenant).inc()
+            log_event("closedloop",
+                      f"CANARY passed tenant={tenant}: candidate "
+                      f"|residual| {new_res:.3e} within gate {g:.3e} "
+                      f"(old engine replays {old_res:.3e})",
+                      verbose=False, event="canary", tenant=str(tenant),
+                      passed=True, old_residual=old_res,
+                      new_residual=new_res, gate=g)
+
+        # the atomic flip: flush what the OLD engine owes its waiters,
+        # then replace the loaded entry in place — requests submitted
+        # after this line batch against the (already warm) new engine
+        t1 = self._clock()
+        old.flush()
+        self._loaded[tenant] = LoadedTenant(
+            tenant, sur, engine, reg.policy, scope, self._clock, warm)
+        reg.artifact = str(artifact)
+        reg.f_model = f_model
+        reg.net = net
+        reg.quarantine = []  # old rungs' history does not apply to v2
+        stall = self._clock() - t1
+        self._registry.counter("fleet.swap.flips", tenant=tenant).inc()
+        self._registry.histogram("fleet.swap.cutover_stall_s",
+                                 tenant=tenant).observe(stall)
+        verdict.update(swapped=True, reason="swapped",
+                       cutover_stall_s=stall)
+        log_event("closedloop",
+                  f"SWAPPED tenant={tenant} to {artifact} "
+                  f"(cutover stall {stall * 1e3:.2f}ms, warm start: "
+                  f"{warm.get('aot', 0)} AOT + {warm.get('jit', 0)} jit)",
+                  verbose=False, event="swap", tenant=str(tenant),
+                  artifact=str(artifact), cutover_stall_s=stall)
+        return verdict
+
+    def _reject(self, tenant: str, old: LoadedTenant, probe, u_before,
+                verdict: dict, *, reason: str,
+                detail: Optional[str] = None) -> None:
+        """Candidate rejection: record the rollback, and PROVE the old
+        engine still serves bit-identically by replaying the probe
+        against the pre-swap snapshot."""
+        if probe is not None:
+            u_after = np.asarray(old.engine.u(probe)).tobytes()
+            verdict["bit_identical"] = u_after == u_before
+        self._registry.counter("fleet.swap.rollbacks", tenant=tenant).inc()
+        verdict.update(reason=reason, **({"detail": detail} if detail
+                                         else {}))
+        log_event("closedloop",
+                  f"ROLLBACK: tenant={tenant} kept its old engine "
+                  f"({reason}" + (f": {detail}" if detail else "")
+                  + ("; probe replay bit-identical"
+                     if verdict.get("bit_identical") else "") + ")",
+                  level="warning", verbose=False, event="rollback",
+                  tenant=str(tenant), reason=reason,
+                  bit_identical=verdict.get("bit_identical"))
+
+    # ------------------------------------------------------------------ #
     def submit(self, tenant: str, X, kind: str = "u",
                priority: Optional[int] = None):
         """Admission-gated submit: the request passes the

@@ -75,6 +75,27 @@ Cluster faults (the elastic multi-host failure model —
   Training then continues — a supervisor whose heartbeat timeout is
   properly above the stall must NOT declare a loss (the
   false-positive-relaunch guard).
+
+Closed-loop faults (the drift → retrain → hot-swap cycle —
+:mod:`tensordiffeq_tpu.fleet.closedloop`):
+
+* ``drift_inject`` — the first shadow probe after a tenant's baseline is
+  recorded perturbs that tenant's SERVED params by this relative scale
+  (deterministic multiplicative drift, no RNG), so the
+  :class:`~tensordiffeq_tpu.fleet.DriftMonitor` trips on demand in
+  tests.
+* ``retrain_kill_at`` — the retrain trainer is killed (a
+  :class:`ChaosFault` at the first retrain chunk boundary at-or-past
+  this epoch): the controller's supervisor loop must relaunch the
+  generation with :class:`~tensordiffeq_tpu.resilience.RetryPolicy`
+  backoff and complete the retrain.  ``retrain_kill_repeats`` budgets
+  the kills (default 1).
+* ``swap_corrupt_member`` — the member artifact with this index in a
+  freshly exported family batch gets its largest AOT payload torn
+  (truncate + garble, the ``torn_checkpoint_nth`` treatment): the swap's
+  candidate load must fail the artifact checksum, the swap ships
+  WITHOUT that member, and the member's old engine keeps serving
+  bit-identically.
 """
 
 from __future__ import annotations
@@ -92,6 +113,29 @@ _ENV_VAR = "TDQ_CHAOS"
 #: tests can tell the injected loss from an organic crash; the supervisor
 #: itself treats ANY non-0/non-75 exit as a lost host.
 HOST_LOSS_EXIT_CODE = 113
+
+
+def _tear_largest_payload(path: str):
+    """Truncate + garble the largest non-meta payload file under
+    ``path`` (storage-level corruption of a fully-promoted directory).
+    The meta file — which carries the content checksum — survives, so
+    validation MUST catch the tear.  Returns ``(victim, original_size)``
+    (``(None, -1)`` when there was nothing to tear)."""
+    victim, size = None, -1
+    for root, _, files in os.walk(path):
+        for f in files:
+            if f == "tdq_meta.json":
+                continue  # the meta (with its checksum) must survive
+            fp = os.path.join(root, f)
+            if os.path.getsize(fp) > size:
+                victim, size = fp, os.path.getsize(fp)
+    if victim is None:
+        return None, -1
+    with open(victim, "r+b") as fh:
+        fh.truncate(max(size // 2, 1))
+        fh.seek(0)
+        fh.write(b"\xde\xad")
+    return victim, size
 
 
 class ChaosFault(RuntimeError):
@@ -136,7 +180,11 @@ class Chaos:
                  coordinator_timeout: Optional[int] = None,
                  coordinator_timeout_s: float = 3600.0,
                  dcn_stall: Optional[int] = None,
-                 dcn_stall_s: float = 2.0):
+                 dcn_stall_s: float = 2.0,
+                 drift_inject: float = 0.0,
+                 retrain_kill_at: Optional[int] = None,
+                 retrain_kill_repeats: int = 1,
+                 swap_corrupt_member: Optional[int] = None):
         if not 0.0 <= float(serving_fail_rate) <= 1.0:
             raise ValueError(
                 f"serving_fail_rate must be in [0, 1], got {serving_fail_rate}")
@@ -159,6 +207,10 @@ class Chaos:
         self.coordinator_timeout_s = float(coordinator_timeout_s)
         self.dcn_stall = dcn_stall
         self.dcn_stall_s = float(dcn_stall_s)
+        self.drift_inject = float(drift_inject)
+        self.retrain_kill_at = retrain_kill_at
+        self.retrain_kill_repeats = int(retrain_kill_repeats)
+        self.swap_corrupt_member = swap_corrupt_member
         self._rng = np.random.RandomState(self.seed)
         # fire bookkeeping (all monotonic counters, exposed for tests/report)
         self.fired: dict[str, int] = {"nan": 0, "preempt": 0,
@@ -166,7 +218,8 @@ class Chaos:
                                       "serving": 0, "compile": 0,
                                       "fleet_evict": 0, "warmstart": 0,
                                       "host_loss": 0, "coordinator_timeout": 0,
-                                      "dcn_stall": 0}
+                                      "dcn_stall": 0, "drift_inject": 0,
+                                      "retrain_kill": 0, "swap_corrupt": 0}
         self._serving_ops = 0
         self._checkpoints = 0
         self._fleet_accesses = 0
@@ -198,7 +251,7 @@ class Chaos:
             if key == "compile_fail_buckets":
                 kwargs[key] = [int(v) for v in val.split("+") if v]
             elif key in ("serving_fail_rate", "coordinator_timeout_s",
-                         "dcn_stall_s"):
+                         "dcn_stall_s", "drift_inject"):
                 kwargs[key] = float(val)
             else:
                 kwargs[key] = int(val)
@@ -222,7 +275,11 @@ class Chaos:
                              ("coordinator_timeout", None),
                              ("coordinator_timeout_s", 3600.0),
                              ("dcn_stall", None),
-                             ("dcn_stall_s", 2.0)):
+                             ("dcn_stall_s", 2.0),
+                             ("drift_inject", 0.0),
+                             ("retrain_kill_at", None),
+                             ("retrain_kill_repeats", 1),
+                             ("swap_corrupt_member", None)):
             v = getattr(self, key)
             if v != default:
                 parts.append(f"{key}={v:g}" if isinstance(v, float)
@@ -334,20 +391,9 @@ class Chaos:
         self._checkpoints += 1
         if self._checkpoints != int(self.torn_checkpoint_nth):
             return False
-        victim, size = None, -1
-        for root, _, files in os.walk(path):
-            for f in files:
-                if f == "tdq_meta.json":
-                    continue  # the meta (with its checksum) must survive
-                fp = os.path.join(root, f)
-                if os.path.getsize(fp) > size:
-                    victim, size = fp, os.path.getsize(fp)
+        victim, size = _tear_largest_payload(path)
         if victim is None:
             return False
-        with open(victim, "r+b") as fh:
-            fh.truncate(max(size // 2, 1))
-            fh.seek(0)
-            fh.write(b"\xde\xad")
         self.fired["torn_checkpoint"] += 1
         log_event("chaos", f"tore checkpoint payload {victim} "
                   f"({size} -> {max(size // 2, 1)} bytes)", level="warning",
@@ -408,6 +454,60 @@ class Chaos:
             raise ChaosFault(
                 f"injected corrupt AOT program for kind={kind} "
                 f"bucket={bucket} (load #{self._warmstart_loads})")
+
+    # ------------------------------------------------------------------ #
+    def on_drift_probe(self, tenant) -> Optional[float]:
+        """Drift-monitor shadow-probe hook: the FIRST probe taken after
+        this plan activates returns the ``drift_inject`` scale (the
+        monitor perturbs that tenant's served params by it), every later
+        probe returns None.  One-shot and RNG-free, so the monitor trips
+        deterministically."""
+        if not self.drift_inject or self.fired["drift_inject"]:
+            return None
+        self.fired["drift_inject"] += 1
+        log_event("chaos", f"injected parameter drift ({self.drift_inject:g}"
+                  f" relative) into tenant={tenant}'s served params",
+                  level="warning", verbose=False, fault="drift_inject",
+                  tenant=str(tenant), scale=self.drift_inject)
+        return self.drift_inject
+
+    def on_retrain_boundary(self, generation: int, epoch: int):
+        """Retrain chunk-boundary hook: kill the trainer (raise
+        :class:`ChaosFault`) at the first boundary at-or-past
+        ``retrain_kill_at``, up to ``retrain_kill_repeats`` times — the
+        controller's supervisor loop must relaunch the generation with
+        backoff."""
+        if self.retrain_kill_at is None or epoch < int(self.retrain_kill_at):
+            return
+        if self.fired["retrain_kill"] >= self.retrain_kill_repeats:
+            return
+        self.fired["retrain_kill"] += 1
+        log_event("chaos", f"injected retrain kill: generation {generation} "
+                  f"trainer dies at epoch {epoch}", level="warning",
+                  verbose=False, fault="retrain_kill",
+                  generation=generation, epoch=epoch)
+        raise ChaosFault(
+            f"injected trainer kill at retrain epoch {epoch} "
+            f"(generation {generation})")
+
+    def on_member_artifact(self, member: int, path: str) -> bool:
+        """Family-export hook: tear the largest non-meta payload of the
+        ``swap_corrupt_member`` member's freshly exported artifact
+        (truncate + garble), so the hot-swap's candidate load fails the
+        artifact checksum and the swap must ship without that member.
+        Returns whether the tear fired."""
+        if self.swap_corrupt_member is None \
+                or int(member) != int(self.swap_corrupt_member):
+            return False
+        victim, size = _tear_largest_payload(path)
+        if victim is None:
+            return False
+        self.fired["swap_corrupt"] += 1
+        log_event("chaos", f"tore member {member}'s artifact payload "
+                  f"{victim} ({size} -> {max(size // 2, 1)} bytes)",
+                  level="warning", verbose=False, fault="swap_corrupt",
+                  member=int(member), path=str(path))
+        return True
 
     # ------------------------------------------------------------------ #
     def __enter__(self) -> "Chaos":

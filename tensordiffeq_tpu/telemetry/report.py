@@ -140,6 +140,9 @@ def summarize(run_dir: str) -> dict:
         "cluster_events": of_kind("cluster"),
         # fleet trail (PR 6): loads/evictions, shed traffic, warm starts
         "fleet_events": of_kind("fleet"),
+        # closed-loop trail (PR 18): drift trips, retrain generations,
+        # canary verdicts, swaps and rollbacks
+        "closedloop_events": of_kind("closedloop"),
         "admission_rejections": [e for e in of_kind("admission")
                                  if e.get("reason")],
         "warmstarts": [e for e in of_kind("warmstart")
@@ -280,6 +283,46 @@ def report(run_dir: str, width: int = 72) -> str:
             f"{_fmt(ws.get('aot'))} AOT + {_fmt(ws.get('jit'))} jit "
             f"program(s) in {_fmt(ws.get('wall_s'))}s"
             + (f" ({ws['failed']} degraded)" if ws.get("failed") else ""))
+    # -- closed-loop trail: drift -> retrain -> canary -> swap ---------- #
+    for e in s["closedloop_events"]:
+        ev = e.get("event")
+        if ev == "drift":
+            lines.append(
+                f"DRIFT detected: tenant {_fmt(e.get('tenant'))} at "
+                f"{_fmt(e.get('drift_level'))}x its baseline residual "
+                f"(threshold {_fmt(e.get('threshold'))}x)")
+        elif ev == "retrain":
+            lines.append(
+                f"RETRAIN launched: generation {_fmt(e.get('generation'))}"
+                f", {_fmt(e.get('members'))} member(s), epochs "
+                f"{_fmt(e.get('start_epoch'))}.."
+                f"{_fmt(e.get('target_epochs'))}"
+                + (" (relaunch after trainer death)"
+                   if e.get("relaunch") else ""))
+        elif ev == "retrain_death":
+            lines.append(
+                f"  trainer died at epoch {_fmt(e.get('epoch'))} "
+                f"(generation {_fmt(e.get('generation'))}); backoff "
+                f"{_fmt(e.get('backoff_s'))}s before relaunch")
+        elif ev == "canary":
+            verdict = "passed" if e.get("passed") else "REGRESSED"
+            lines.append(
+                f"CANARY {verdict}: tenant {_fmt(e.get('tenant'))} "
+                f"candidate |residual| {_fmt(e.get('new_residual'))} vs "
+                f"gate {_fmt(e.get('gate'))} "
+                f"(old engine {_fmt(e.get('old_residual'))})")
+        elif ev == "swap":
+            lines.append(
+                f"SWAPPED: tenant {_fmt(e.get('tenant'))} cut over in "
+                f"{_fmt(e.get('cutover_stall_s'))}s "
+                "(zero request-time compiles)")
+        elif ev == "rollback":
+            lines.append(
+                f"ROLLED BACK: tenant {_fmt(e.get('tenant'))} kept its "
+                f"old engine ({_fmt(e.get('reason'))}"
+                + ("; probe replay bit-identical"
+                   if e.get("bit_identical") else "") + ")")
+
     if s["admission_rejections"]:
         by_reason: dict = {}
         for e in s["admission_rejections"]:

@@ -69,6 +69,16 @@ def _max_hist_p99(metrics: dict, base: str) -> Optional[float]:
     return worst
 
 
+def _max_gauge(metrics: dict, base: str) -> Optional[float]:
+    """Worst value across every labeled instance of gauge ``base``."""
+    worst = None
+    for key, v in (metrics.get("gauges") or {}).items():
+        if _parse_key(key)[0] != base or not isinstance(v, (int, float)):
+            continue
+        worst = v if worst is None else max(worst, v)
+    return worst
+
+
 def _objective(value, threshold) -> dict:
     """One objective's verdict row.  ``ok`` is None when there is no
     data — absence of traffic is not a breach."""
@@ -93,6 +103,14 @@ class SLOSet:
       max_step_regression: trailing-window training step time over the
         run's own opening-baseline window (1.5 = "no more than 50%
         slower than the run started out").
+      max_residual_drift: worst acceptable served-residual drift across
+        the fleet's drift-monitored tenants — the windowed shadow-probe
+        residual over the tenant's own attach-time baseline
+        (``fleet.drift.level`` gauges, written by
+        :class:`~tensordiffeq_tpu.fleet.DriftMonitor`; 3.0 = "a tenant
+        may degrade to 3x its export-time residual before the retrain
+        loop owes a response").  Like every objective, no monitored
+        traffic means no verdict (``ok=None``), not a breach.
       window: events per window for the step-regression comparison.
     """
 
@@ -100,13 +118,18 @@ class SLOSet:
                  max_rejected_fraction: float = 0.05,
                  max_timeout_fraction: float = 0.01,
                  max_step_regression: float = 1.5,
+                 max_residual_drift: float = 3.0,
                  window: int = 20):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        if max_residual_drift <= 0:
+            raise ValueError("max_residual_drift must be > 0, got "
+                             f"{max_residual_drift}")
         self.serving_p99_s = float(serving_p99_s)
         self.max_rejected_fraction = float(max_rejected_fraction)
         self.max_timeout_fraction = float(max_timeout_fraction)
         self.max_step_regression = float(max_step_regression)
+        self.max_residual_drift = float(max_residual_drift)
         self.window = int(window)
 
     @classmethod
@@ -142,6 +165,14 @@ class SLOSet:
             "step_time_regression": _objective(
                 self._step_regression(events or []),
                 self.max_step_regression),
+            # served-residual drift (PR 18): the closed loop's trip wire.
+            # The DriftMonitor writes one fleet.drift.level gauge per
+            # monitored tenant (windowed probe residual / attach-time
+            # baseline); the objective judges the worst of them, and its
+            # burn_rate is what arms the RetrainController
+            "residual_drift": _objective(
+                _max_gauge(metrics, "fleet.drift.level"),
+                self.max_residual_drift),
         }
         breaches = sorted(k for k, o in objectives.items()
                           if o["ok"] is False)

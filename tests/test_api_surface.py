@@ -37,7 +37,9 @@ FACTORY_RESAMPLING = ["FamilyResampler", "carry_rows_family"]
 FLEET = ["FleetRouter", "TenantPolicy", "LoadedTenant",
          "AdmissionController", "AdmissionRejected", "PRIORITIES",
          "export_fleet_artifact", "warm_start", "AOT_SUBDIR",
-         "DEFAULT_KINDS"]
+         "DEFAULT_KINDS",
+         # the closed loop (PR 18)
+         "DriftMonitor", "RetrainController"]
 
 # the elastic multi-host surface (docs/api.md Elastic/Cluster section, PR 8)
 ELASTIC_RESILIENCE = ["ClusterSupervisor", "ClusterResult",

@@ -25,9 +25,10 @@ def resample_bench_proc():
     whose supervisors spend much of their wall in probe timeouts and
     idle waits) instead of serializing after them.
     ``test_resample_json_contract_on_cpu_fallback`` is deliberately the
-    LAST test in the file — it joins the process there (tier-1 wall
-    discipline: the suite brushes its 870 s gate on this host, so new
-    subprocess work must hide behind existing waits, not add to them)."""
+    second-to-LAST test in the file (the closedloop join is last) — it
+    joins the process there (tier-1 wall discipline: the suite brushes
+    its 870 s gate on this host, so new subprocess work must hide behind
+    existing waits, not add to them)."""
     cache_dir = tempfile.mkdtemp(prefix="bench_resample_cache_")
     env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="560",
                JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
@@ -44,13 +45,35 @@ def resample_bench_proc():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def closedloop_bench_proc():
+    """Start the --closedloop contract subprocess at module setup with
+    the other two (same wall discipline: the drift -> retrain -> swap
+    cycle cooks behind this module's in-process tests).  Joined by
+    ``test_closedloop_json_contract_on_cpu_fallback``, the LAST test in
+    the file — the resample join moves up to second-to-last."""
+    cache_dir = tempfile.mkdtemp(prefix="bench_closedloop_cache_")
+    env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="560",
+               JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
+               PALLAS_AXON_POOL_IPS="", BENCH_TPU_CACHE_DIR=cache_dir)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
+         "closedloop"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    yield proc
+    if proc.poll() is None:  # join test skipped/failed early: reap it
+        proc.kill()
+        proc.communicate()
+
+
+@pytest.fixture(scope="module", autouse=True)
 def factory_bench_proc():
     """Start the --factory contract subprocess alongside the --resample
     one at module setup (same wall discipline: the family-vs-sequential
     race cooks behind this module's in-process tests and the resample
     race's idle probe waits).  Joined by
-    ``test_factory_json_contract_on_cpu_fallback``, second-to-last in
-    the file — the resample join stays last."""
+    ``test_factory_json_contract_on_cpu_fallback``, third-to-last in
+    the file — then the resample join, then the closedloop join last."""
     cache_dir = tempfile.mkdtemp(prefix="bench_factory_cache_")
     env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="420",
                JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
@@ -564,6 +587,32 @@ def test_serving_json_contract_on_cpu_fallback(tmp_path):
     assert p["backend"] == "cpu"  # this env: the fallback really ran
 
 
+def test_closedloop_mode_registered():
+    """--closedloop is a first-class mode: distinct cache artifact, a
+    budget entry, and the --mode spelling maps onto it (budget entry
+    pinned by the subprocess contract test running inside its
+    BENCH_BUDGET)."""
+    bench = _load_bench()
+    assert bench.mode_name(["--closedloop"]) == "closedloop"
+    assert bench.tpu_cache_file(["--closedloop"]).endswith(
+        "BENCH_TPU_closedloop.json")
+
+
+def test_closedloop_partial_carries_real_headline():
+    """The detection-phase partial streamed by --closedloop must publish
+    the measured drift-detection latency as a real headline with the
+    incompleteness disclosed — never the final payload's MTTR value."""
+    bench = _load_bench()
+    p = bench.closedloop_partial(
+        {"metric": "closed-loop MTTR: drift injection -> every tenant "
+                   "hot-swapped (2 tenants)",
+         "value": None, "unit": "s",
+         "detection": {"wall_s": 0.21, "queries_to_trip": 5}})
+    assert p["value"] == 0.21
+    assert "incomplete" in p["metric"]
+    assert "note" in p and p["unit"].startswith("s")
+
+
 def test_resample_mode_registered():
     """--resample is a first-class mode: distinct cache artifact, a
     budget entry, and the --mode spelling maps onto it."""
@@ -738,8 +787,9 @@ def test_factory_json_contract_on_cpu_fallback(factory_bench_proc):
     means a distinct program, the exact cost the one-program family
     deletes; measured 6.5x on this host).  The idealized shared-scan
     arm (sequential granted the one-program property) is disclosed
-    alongside.  KEEP SECOND-TO-LAST: the subprocess was started by the
-    module fixture, so joining here pays only the residual wall."""
+    alongside.  KEEP THIRD-TO-LAST (before the resample and closedloop
+    joins): the subprocess was started by the module fixture, so joining
+    here pays only the residual wall."""
     out, err = factory_bench_proc.communicate(timeout=580)
     assert factory_bench_proc.returncode == 0, err[-2000:]
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
@@ -773,10 +823,10 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     the >=3x bar leaves throttle headroom), and (3) the PACMANN ascent
     arm reaches the gate in fewer steps than the pool->top-k arm at the
     same cadence (measured 2300 vs 3300) with the same pipelined ms-band
-    stall.  KEEP THIS TEST LAST IN THE
-    FILE: the subprocess was started by the module fixture before the
-    other contract tests ran, so joining here pays only the residual
-    wall, not the full race."""
+    stall.  KEEP SECOND-TO-LAST (only the
+    closedloop join follows): the subprocess was started by the module
+    fixture before the other contract tests ran, so joining here pays
+    only the residual wall, not the full race."""
     out, err = resample_bench_proc.communicate(timeout=580)
     assert resample_bench_proc.returncode == 0, err[-2000:]
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
@@ -815,4 +865,37 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     assert pac["score_gain"] > 1.0 and 0.0 < pac["kept_fraction"] < 1.0
     assert p["redraw_stall_s_p50"]["pacmann"] < \
         p["redraw_stall_s_p50"]["host"]
+    assert p["backend"] == "cpu"  # this env: the fallback really ran
+
+
+def test_closedloop_json_contract_on_cpu_fallback(closedloop_bench_proc):
+    """`python bench.py --mode closedloop` must emit ONE valid JSON line
+    measuring the autonomous cycle end to end — and the contract IS the
+    acceptance bar: drift injected into a served family is detected from
+    shadow-sampled live traffic (SLO trip), the warm-started retrain
+    completes, every tenant hot-swaps behind its canary gate with zero
+    request-time compiles, the cutover stall stays sub-second, and the
+    post-swap probe residual improves on the drifted one (the loop
+    healed the fleet; measured 4x on this host).  KEEP THIS TEST LAST IN
+    THE FILE: the subprocess was started by the module fixture, so
+    joining here pays only the residual wall."""
+    out, err = closedloop_bench_proc.communicate(timeout=580)
+    assert closedloop_bench_proc.returncode == 0, err[-2000:]
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out  # supervisor: exactly one line
+    p = json.loads(lines[0])
+    assert p["unit"] == "s"
+    assert isinstance(p["value"], (int, float)) and p["value"] > 0
+    det = p["detection"]
+    assert det["queries_to_trip"] >= 1 and det["wall_s"] > 0
+    assert det["slo"]["ok"] is False  # the trip WAS an SLO breach
+    assert det["slo"]["threshold"] == 3.0
+    assert p["retrain"]["generations"] >= 1 and p["retrain"]["epochs"] > 0
+    sw = p["swap"]
+    assert sw["swapped"] == p["tenants"] and sw["rolled_back"] == 0
+    assert sw["request_time_compiles"] == 0  # nothing compiled at request
+    assert sw["cutover_stall_p50_s"] < 1.0  # the only waiter-visible pause
+    res = p["residual"]
+    assert res["drifted"] > res["baseline"]  # the injection was real
+    assert res["improvement"] > 1.0  # ... and the loop healed it
     assert p["backend"] == "cpu"  # this env: the fallback really ran

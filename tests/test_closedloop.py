@@ -1,0 +1,412 @@
+"""The closed loop (PR 18): drift-triggered factory retraining with
+zero-downtime hot-swap, chaos-proven end to end.
+
+The acceptance anchor is ONE chaotic cycle that survives all three
+injected faults at once — ``drift_inject`` (silent numeric rot on a live
+replica), ``retrain_kill_at`` (the trainer dies mid-retrain and the
+supervisor relaunches it with backoff), and ``swap_corrupt_member`` (a
+torn v2 artifact the checksum must reject, bit-validated rollback) —
+while a member that freezes mid-family (NaN params) is excluded per the
+manifest.  A separate clean cycle pins the hot-swap happy path: zero
+request-time compiles, zero dropped or hung waiters, and a
+canary-regressed candidate demonstrably rolled back.  With no chaos
+active the monitored serve path is pinned bit-identical to a plain
+router serve (the shadow probe is read-only).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensordiffeq_tpu import (DomainND, IC, SurrogateFactory, dirichletBC,
+                              grad, telemetry)
+from tensordiffeq_tpu.fleet import (DriftMonitor, FleetRouter,
+                                    RetrainController, TenantPolicy)
+from tensordiffeq_tpu.resilience import Chaos, RetryPolicy
+from tensordiffeq_tpu.telemetry import SLOSet, report
+
+N_F = 256
+LAYERS = [2, 12, 12, 1]
+MIN_B, MAX_B = 64, 128
+THETAS = [0.001, 0.002, 0.003]
+
+
+def make_domain():
+    d = DomainND(["x", "t"], time_var="t")
+    d.add("x", [-1.0, 1.0], 32)
+    d.add("t", [0.0, 1.0], 8)
+    d.generate_collocation_points(N_F, seed=0)
+    return d
+
+
+def make_bcs(d):
+    return [IC(d, [lambda x: x ** 2 * np.cos(np.pi * x)], var=[["x"]]),
+            dirichletBC(d, val=0.0, var="x", target="upper"),
+            dirichletBC(d, val=0.0, var="x", target="lower")]
+
+
+def f_model_fam(u, x, t, th):
+    return grad(u, "t")(x, t) - th * grad(grad(u, "x"), "x")(x, t) \
+        + 5.0 * u(x, t) ** 3 - 5.0 * u(x, t)
+
+
+def build_factory(init_params=None, poison_member=None):
+    """The controller's ``build_factory`` hook.  ``poison_member`` NaNs
+    that member's warm start, so it freezes at the first retrain chunk —
+    the deterministic stand-in for a member diverging mid-family."""
+    if init_params is not None and poison_member is not None:
+        init_params = list(init_params)
+        init_params[poison_member] = jax.tree_util.tree_map(
+            lambda a: jnp.full_like(a, jnp.nan),
+            init_params[poison_member])
+    d = make_domain()
+    return SurrogateFactory(LAYERS, f_model_fam, d, make_bcs(d),
+                            thetas=THETAS, init_params=init_params,
+                            verbose=False)
+
+
+def query_points(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.uniform(-1, 1, n),
+                     rng.uniform(0, 1, n)], -1).astype(np.float32)
+
+
+def small_policy():
+    return TenantPolicy(min_bucket=MIN_B, max_bucket=MAX_B, max_batch=256,
+                        max_latency_s=0.005)
+
+
+def engine_compiles():
+    return sum(v for k, v in
+               telemetry.default_registry().as_dict()["counters"].items()
+               if k.startswith("serving.engine.compiles"))
+
+
+def leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(la, lb))
+
+
+def u_bytes(router, tenant, X):
+    return np.asarray(router.query(tenant, X)).tobytes()
+
+
+@pytest.fixture(scope="module")
+def family_v1(tmp_path_factory):
+    """One trained M=3 family + its exported v1 artifact batch, shared
+    by every serving test in this module (tier-1 wall discipline)."""
+    fac = build_factory()
+    fac.fit(tf_iter=20, chunk=10)
+    v1 = str(tmp_path_factory.mktemp("closedloop") / "v1")
+    fac.export_family(v1, min_bucket=MIN_B, max_bucket=MAX_B)
+    return {"factory": fac, "v1": v1}
+
+
+@pytest.fixture(scope="module")
+def chaotic(family_v1, tmp_path_factory):
+    """THE acceptance cycle: one closed-loop run under all three chaos
+    faults at once, captured inside a RunLogger so the narration tests
+    read the same trail an operator would."""
+    fac = family_v1["factory"]
+    run_dir = str(tmp_path_factory.mktemp("chaotic") / "run")
+    workdir = str(tmp_path_factory.mktemp("chaotic_v2"))
+    router = FleetRouter(max_loaded=4)
+    probe = query_points(MIN_B)
+    sleeps = []
+    out = {"router": router, "probe": probe, "run_dir": run_dir,
+           "sleeps": sleeps}
+    with telemetry.RunLogger(run_dir, config={"test": "closedloop"}):
+        members = router.register_family(
+            family_v1["v1"], policy=small_policy(), prefix="t",
+            f_models={m: fac.member_f_model(m) for m in range(3)})
+        out["members"] = members
+        monitor = DriftMonitor(router, sample_fraction=1.0, window=2,
+                               seed=0)
+        for t in members.values():
+            router.load(t)
+            monitor.attach(t, probe)
+        out["monitor"] = monitor
+        # drift_inject lands on the FIRST tenant probed (t000); the
+        # other two must keep serving their OLD engines bit-identically
+        # through the torn artifact and the frozen member
+        out["u_before"] = {m: u_bytes(router, members[m], probe)
+                           for m in (1, 2)}
+        chaos = Chaos(drift_inject=2.0, retrain_kill_at=10,
+                      swap_corrupt_member=1, seed=0)
+        out["chaos"] = chaos
+        with chaos:
+            served = 0
+            while not monitor.tripped() and served < 60:
+                t = members[served % 3]
+                monitor.query(t, query_points(8, seed=served + 1))
+                served += 1
+            out["served_to_trip"] = served
+            out["slo_at_trip"] = monitor.evaluate()
+            controller = RetrainController(
+                router, monitor,
+                lambda ip: build_factory(ip, poison_member=2),
+                members, retrain_iters=40, chunk=10, resample_every=0,
+                retry=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                  jitter=0.0),
+                gate_ratio=50.0,
+                export_kw=dict(min_bucket=MIN_B, max_bucket=MAX_B),
+                workdir=workdir, sleep=sleeps.append, verbose=False)
+            out["cycle"] = controller.run_cycle()
+        pre = engine_compiles()
+        out["u_after"] = {m: u_bytes(router, t, probe)
+                          for m, t in members.items()}
+        out["post_swap_compiles"] = engine_compiles() - pre
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# the chaotic acceptance cycle
+# --------------------------------------------------------------------------- #
+def test_drift_injection_trips_the_monitor(chaotic):
+    """Silent numeric rot on the served params is caught from shadow
+    probes of live traffic — and the trip IS an SLO breach at trip time."""
+    assert chaotic["chaos"].fired["drift_inject"] == 1
+    cycle = chaotic["cycle"]
+    assert cycle["triggered"] and cycle["tripped"] == ["t000"]
+    # one query was enough: probe-every-query + a 2x param scale
+    assert 1 <= chaotic["served_to_trip"] <= 6
+    o = chaotic["slo_at_trip"]["objectives"]["residual_drift"]
+    assert o["ok"] is False and o["value"] > 3.0 and o["burn_rate"] > 1.0
+
+
+def test_trainer_death_relaunches_with_backoff(chaotic):
+    """retrain_kill_at kills generation 1 at its first chunk boundary;
+    the supervisor loop relaunches generation 2 after RetryPolicy
+    backoff and the retrain completes its full epoch budget."""
+    assert chaotic["chaos"].fired["retrain_kill"] == 1
+    cycle = chaotic["cycle"]
+    assert cycle["generations"] == 2 and cycle["trainer_kills"] == 1
+    assert cycle["retrain_epochs"] == 40
+    # the backoff really slept the policy's deterministic first delay
+    assert chaotic["sleeps"] == [pytest.approx(0.01)]
+
+
+def test_corrupted_member_rejected_swap_ships_without_it(chaotic):
+    """swap_corrupt_member tears member 1's v2 payload: the checksum
+    rejects it at load, the rollback is bit-validated by probe replay,
+    and the rest of the batch still ships."""
+    assert chaotic["chaos"].fired["swap_corrupt"] == 1
+    cycle = chaotic["cycle"]
+    rolled = {v["tenant"]: v for v in cycle["rolled_back"]}
+    assert rolled["t001"]["reason"] == "artifact_rejected"
+    assert rolled["t001"]["bit_identical"] is True
+    assert rolled["t001"]["member"] == 1
+    swapped = {v["tenant"] for v in cycle["swapped"]}
+    assert swapped == {"t000"}  # the drifted tenant healed
+
+
+def test_frozen_member_excluded_per_manifest(chaotic):
+    """The NaN-poisoned member froze mid-family: the v2 manifest
+    excludes it, and its tenant keeps the old engine (narrated as a
+    rollback — that is what the route does)."""
+    cycle = chaotic["cycle"]
+    assert cycle["frozen"] == [2] and cycle["exported"] == [0, 1]
+    rolled = {v["tenant"]: v for v in cycle["rolled_back"]}
+    assert rolled["t002"]["reason"] == "member_frozen"
+    from tensordiffeq_tpu.factory import FAMILY_MANIFEST
+    with open(os.path.join(cycle["v2_dir"], FAMILY_MANIFEST)) as fh:
+        manifest = json.load(fh)
+    assert "2" not in manifest["members"] and "2" in manifest["frozen"]
+
+
+def test_unswapped_tenants_serve_bit_identically_throughout(chaotic):
+    """Both rolled-back tenants answer byte-for-byte what they answered
+    before the chaos window opened — across the drift injection, the
+    trainer death, the torn artifact, and the neighbor's cutover."""
+    assert chaotic["u_after"][1] == chaotic["u_before"][1]
+    assert chaotic["u_after"][2] == chaotic["u_before"][2]
+
+
+def test_zero_request_time_compiles_after_chaotic_swap(chaotic):
+    """Post-cycle traffic on all three tenants — including the freshly
+    swapped one — compiles nothing at request time (the v2 candidate was
+    warm-driven beside the live tenant before the flip)."""
+    assert chaotic["post_swap_compiles"] == 0
+
+
+def test_swap_resets_the_drift_objective(chaotic):
+    """After the cutover the swapped tenant's gauge is re-anchored: the
+    residual_drift objective is green again (the loop healed the SLO it
+    tripped)."""
+    v = chaotic["monitor"].evaluate()
+    assert v["objectives"]["residual_drift"]["ok"] is True
+    assert "t000" not in chaotic["monitor"].tripped()
+
+
+def test_report_narrates_the_full_closed_loop(chaotic):
+    """The operator-facing trail (satellite: report.py): DRIFT detected,
+    RETRAIN launched (with the relaunch generation), CANARY verdict,
+    SWAPPED, ROLLED BACK — all from one chaotic cycle's run dir."""
+    text = report(chaotic["run_dir"])
+    assert "DRIFT detected: tenant t000" in text
+    assert "RETRAIN launched: generation 1" in text
+    assert "RETRAIN launched: generation 2" in text
+    assert "relaunch after trainer death" in text
+    assert "CANARY passed: tenant t000" in text
+    assert "SWAPPED: tenant t000" in text
+    assert "zero request-time compiles" in text
+    assert "ROLLED BACK: tenant t001" in text
+    assert "artifact_rejected; probe replay bit-identical" in text
+    assert "ROLLED BACK: tenant t002" in text
+    assert "CHAOS ACTIVE" in text and "drift_inject x1" in text
+
+
+# --------------------------------------------------------------------------- #
+# the clean cycle: hot-swap happy path + canary rollback
+# --------------------------------------------------------------------------- #
+def test_clean_cycle_swaps_all_and_canary_rejects_regression(
+        family_v1, tmp_path):
+    """No chaos: organic drift (params perturbed in place) trips the
+    monitor, the controller swaps EVERY member with zero request-time
+    compiles and zero dropped/hung waiters (a request left pending
+    across the flip completes), and a deliberately regressed candidate
+    is then rolled back by the canary gate, bit-validated."""
+    fac = family_v1["factory"]
+    router = FleetRouter(max_loaded=4)
+    members = router.register_family(
+        family_v1["v1"], policy=small_policy(), prefix="c",
+        f_models={m: fac.member_f_model(m) for m in range(3)})
+    monitor = DriftMonitor(router, sample_fraction=1.0, window=2, seed=0)
+    probe = query_points(MIN_B)
+    for t in members.values():
+        router.load(t)
+        monitor.attach(t, probe)
+
+    # organic drift: scale c000's served params in place (the engine
+    # reads surrogate.params at call time — next query sees it)
+    lt = router.load(members[0])
+    lt.surrogate.params = jax.tree_util.tree_map(
+        lambda a: a * 3.0, lt.surrogate.params)
+    served = 0
+    while not monitor.tripped() and served < 60:
+        monitor.query(members[served % 3], query_points(8, seed=served + 1))
+        served += 1
+    assert monitor.tripped() == ("c000",)
+
+    # a waiter left pending across the flip must complete, not hang
+    pending = router.submit(members[0], query_points(5, seed=99))
+
+    controller = RetrainController(
+        router, monitor, build_factory, members,
+        retrain_iters=20, chunk=10, resample_every=0, gate_ratio=50.0,
+        export_kw=dict(min_bucket=MIN_B, max_bucket=MAX_B),
+        workdir=str(tmp_path), verbose=False)
+    pre = engine_compiles()
+    cycle = controller.run_cycle()
+    assert {v["tenant"] for v in cycle["swapped"]} == set(members.values())
+    assert cycle["rolled_back"] == [] and cycle["generations"] == 1
+    assert pending.done  # flushed by the flip, not dropped
+    assert np.asarray(pending.result()).shape[0] == 5
+    for t in members.values():
+        router.query(t, probe)
+    assert engine_compiles() - pre == 0  # nothing compiled at request time
+
+    # canary rollback: re-offer the v1 member-0 artifact with an
+    # impossible gate — the candidate must be rejected and the freshly
+    # swapped engine kept, bit-validated by probe replay
+    before = u_bytes(router, members[0], probe)
+    verdict = router.hot_swap(
+        members[0], os.path.join(family_v1["v1"], "member_000"),
+        f_model=fac.member_f_model(0), probe_X=probe, gate=0.0)
+    assert verdict["swapped"] is False
+    assert verdict["reason"] == "canary_regressed"
+    assert verdict["bit_identical"] is True
+    assert u_bytes(router, members[0], probe) == before
+
+
+# --------------------------------------------------------------------------- #
+# chaos-off bit-identity + monitor units
+# --------------------------------------------------------------------------- #
+def test_chaos_off_monitored_serve_is_bit_identical(chaotic):
+    """Satellite pin: with no chaos active the monitored path returns
+    exactly what the plain router returns, and the shadow probe leaves
+    the engine's answers untouched."""
+    router, monitor = chaotic["router"], chaotic["monitor"]
+    tenant = chaotic["members"][1]  # never drifted, never swapped
+    X = query_points(32, seed=7)
+    plain = np.asarray(router.query(tenant, X)).tobytes()
+    monitored = np.asarray(monitor.query(tenant, X)).tobytes()
+    assert monitored == plain
+    assert np.asarray(router.query(tenant, X)).tobytes() == plain
+
+
+def test_monitor_validation_and_no_traffic():
+    with pytest.raises(ValueError, match="sample_fraction"):
+        DriftMonitor(object(), sample_fraction=1.5)
+    with pytest.raises(ValueError, match="window"):
+        DriftMonitor(object(), window=0)
+    m = DriftMonitor(object(), registry=telemetry.MetricsRegistry())
+    assert m.drift("ghost") is None  # no traffic, no verdict
+    assert m.tripped() == ()
+    # ... and the SLO agrees: absence of probes is not a breach
+    assert m.evaluate()["objectives"]["residual_drift"]["ok"] is None
+
+
+def test_monitor_windowing_uses_pinned_probe_set(chaotic):
+    """probe() with no X replays the attach-time pinned set, and the
+    drift level is the windowed mean over the last ``window`` probes."""
+    monitor = chaotic["monitor"]
+    tenant = chaotic["members"][1]
+    monitor.probe(tenant)
+    l2 = monitor.probe(tenant)
+    # probe() returns the WINDOWED mean, which is what drift() reads back
+    assert monitor.drift(tenant) == pytest.approx(l2, rel=1e-6)
+    # an un-drifted tenant replaying its own baseline set sits near 1x
+    assert 0.5 < l2 < 2.0
+
+
+def test_retrain_controller_idle_poll_is_cheap(chaotic):
+    """run_cycle with nothing tripped is a no-op dict, not a retrain."""
+    router, monitor = chaotic["router"], chaotic["monitor"]
+    c = RetrainController(router, monitor, build_factory,
+                          chaotic["members"])
+    assert c.run_cycle() == {"triggered": False}
+    with pytest.raises(ValueError, match="retrain_iters"):
+        RetrainController(router, monitor, build_factory,
+                          chaotic["members"], retrain_iters=0)
+
+
+# --------------------------------------------------------------------------- #
+# chaos spec round-trip (satellite: resilience/chaos.py)
+# --------------------------------------------------------------------------- #
+def test_chaos_spec_roundtrip_closed_loop_knobs():
+    c = Chaos(drift_inject=0.25, retrain_kill_at=5, retrain_kill_repeats=2,
+              swap_corrupt_member=3, seed=7)
+    assert Chaos.from_spec(c.spec()).spec() == c.spec()
+    # the float knob survives the string form exactly
+    assert "drift_inject=0.25" in c.spec()
+    # defaults stay out of the spec (chaos-off round-trips to chaos-off)
+    assert Chaos().spec() == ""
+    assert Chaos.from_spec("retrain_kill_at=5").retrain_kill_at == 5
+
+
+# --------------------------------------------------------------------------- #
+# factory warm start (init_params)
+# --------------------------------------------------------------------------- #
+def test_factory_init_params_adoption_and_validation(family_v1):
+    """init_params replaces the PRNG init bit-for-bit; a wrong-length
+    list or wrong-shaped member tree fails loudly at build time."""
+    fac = family_v1["factory"]
+    given = [fac.member_params(m) for m in range(3)]
+    fac2 = build_factory(init_params=given)
+    for m in range(3):
+        assert leaves_equal(fac2.member_params(m), given[m])
+    with pytest.raises(ValueError, match="init_params"):
+        build_factory(init_params=given[:2])
+    bad = list(given)
+    bad[1] = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((2, 2), jnp.float32), given[1])
+    with pytest.raises(ValueError, match="init_params"):
+        build_factory(init_params=bad)

@@ -96,6 +96,20 @@ def test_ac_factory_example_runs():
 
 
 @pytest.mark.slow
+def test_ac_closedloop_example_runs():
+    """The PR-18 acceptance demo: a served family is drift-injected
+    under chaos, the DriftMonitor trips the residual_drift SLO from
+    shadow-sampled live traffic, the RetrainController retrains the
+    family warm-started from the drifted served params and hot-swaps it
+    — while chaos tears one v2 member's artifact, survived by a
+    bit-validated rollback with zero request-time compiles (the script
+    itself asserts all of this).  Marked slow for tier-1 wall budget:
+    the same loop runs fast in tests/test_closedloop.py; this adds the
+    full fresh-run E2E and the narrated report on top."""
+    run_example("ac_closedloop.py")
+
+
+@pytest.mark.slow
 def test_ac_resilient_example_runs():
     """The PR-5 acceptance demo: ONE supervised run survives a chaos NaN
     divergence and a chaos preemption, the serving leg heals injected

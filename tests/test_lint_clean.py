@@ -74,8 +74,13 @@ def test_jaxpr_audit_pins_zero_host_hops_in_hot_programs():
     program per family step" (PR 15) is judged here like its PR 12
     siblings."""
     from tensordiffeq_tpu.analysis.jaxpr_audit import HOT_PROGRAMS, audit
+    # serving-u / serving-residual stay pinned by name: the DriftMonitor's
+    # shadow probe (PR 18) rides the serving-residual program for every
+    # sampled live query, so a host hop there would tax ALL monitored
+    # traffic, not just training
     assert {"fused-minimax-step", "fused-minimax-system-step",
             "device-resampler", "ascent-resampler",
+            "serving-u", "serving-residual",
             "vmapped-factory-step"} <= set(HOT_PROGRAMS)
     for name in HOT_PROGRAMS:
         report = audit(name)

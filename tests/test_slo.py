@@ -120,6 +120,54 @@ def test_router_autoscale_carries_slo_verdict():
 def test_slo_validation():
     with pytest.raises(ValueError):
         SLOSet(window=0)
+    with pytest.raises(ValueError):
+        SLOSet(max_residual_drift=0.0)
+
+
+# --------------------------------------------------------------------------- #
+# the residual_drift objective (PR 18: the DriftMonitor's trip wire)
+# --------------------------------------------------------------------------- #
+def test_residual_drift_objective_reads_worst_tenant_gauge():
+    reg = MetricsRegistry()
+    reg.gauge("fleet.drift.level", tenant="a").set(1.1)
+    reg.gauge("fleet.drift.level", tenant="b").set(4.2)
+    v = SLOSet.default().evaluate(reg)
+    o = v["objectives"]["residual_drift"]
+    # worst tenant defines the verdict (one drifting replica is a breach)
+    assert o["value"] == pytest.approx(4.2) and o["ok"] is False
+    assert o["threshold"] == 3.0
+    assert o["burn_rate"] == pytest.approx(4.2 / 3.0)
+    assert "residual_drift" in v["breaches"]
+    # a healed fleet (gauges re-anchored at 1x) is green again
+    reg.gauge("fleet.drift.level", tenant="b").set(1.0)
+    assert SLOSet.default().evaluate(reg)[
+        "objectives"]["residual_drift"]["ok"] is True
+    # no monitor, no gauge, no verdict: absence of traffic != breach
+    assert SLOSet.default().evaluate(MetricsRegistry())[
+        "objectives"]["residual_drift"]["ok"] is None
+    # threshold is tunable like every other objective
+    lax = SLOSet(max_residual_drift=10.0)
+    reg.gauge("fleet.drift.level", tenant="b").set(4.2)
+    assert lax.evaluate(reg)["objectives"]["residual_drift"]["ok"] is True
+
+
+def test_drift_gauges_survive_prometheus_round_trip():
+    """Satellite pin (docs/metrics.md drift guard rides separately): the
+    fleet.drift/canary/swap instruments expose cleanly — dotted names to
+    underscores, tenant labels intact, values exact."""
+    reg = MetricsRegistry()
+    reg.gauge("fleet.drift.level", tenant="a").set(2.5)
+    reg.counter("fleet.canary.rejected", tenant="a").inc(2)
+    reg.counter("fleet.swap.flips", tenant="a").inc()
+    reg.histogram("fleet.swap.cutover_stall_s",
+                  tenant="a").observe_many([0.001, 0.003])
+    samples, types = parse_exposition(to_prometheus(reg))
+    assert samples[("fleet_drift_level", (("tenant", "a"),))] == 2.5
+    assert samples[("fleet_canary_rejected_total", (("tenant", "a"),))] == 2
+    assert samples[("fleet_swap_flips_total", (("tenant", "a"),))] == 1
+    assert types["fleet_swap_cutover_stall_s"] == "summary"
+    assert samples[("fleet_swap_cutover_stall_s_count",
+                    (("tenant", "a"),))] == 2
 
 
 # --------------------------------------------------------------------------- #

@@ -659,9 +659,160 @@ class PallasCoverageRule(Rule):
         return findings
 
 
+# --------------------------------------------------------------------- #
+# 9 · span-leak
+# --------------------------------------------------------------------- #
+
+class SpanLeakRule(Rule):
+    """A span that is opened and never closed never emits its ``trace``
+    record: the run log shows the parent finishing instantly, the
+    Perfetto export drops the slice, and every child becomes an orphan
+    root in ``span_tree`` (PR 19 — the flight recorder's "final span"
+    narration is only trustworthy if spans reliably close).
+
+    Two shapes are flagged: ``tracer.span(...)`` whose result is not
+    entered with ``with`` (the context manager never runs, so the span
+    never even opens), and ``open_span(...)`` whose result is discarded
+    or bound to a name that is neither ``close_span``'d nor escapes the
+    scope.  Escapes — passed as a call argument, returned, yielded,
+    stored to an attribute, aliased — count as closed (no
+    interprocedural analysis; under-report by design).
+    """
+
+    id = "span-leak"
+    doc = "tracer.span() entered via with; open_span() results " \
+          "close_span'd or escaping the scope"
+
+    #: the tracer implementation itself builds/returns spans freely
+    SKIP = ("tensordiffeq_tpu/telemetry/tracing.py",)
+    #: receiver-name filter for bare ``.span`` (``re.Match.span()`` and
+    #: friends must not trip the rule); open/close_span are unambiguous
+    _TRACERISH = ("tr", "tracer")
+
+    def files(self, module: ParsedModule) -> bool:
+        return module.rel not in self.SKIP
+
+    @staticmethod
+    def _scopes(tree):
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _parents(scope) -> dict:
+        """child -> parent map over the scope, not descending into
+        nested defs (their spans are judged in their own scope)."""
+        out = {}
+
+        def build(node):
+            for ch in ast.iter_child_nodes(node):
+                out[ch] = node
+                if not isinstance(ch, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef, ast.Lambda)):
+                    build(ch)
+
+        build(scope)
+        return out
+
+    def _consumption(self, call, parents):
+        """How the span-call's value is consumed: ``('with', None)``,
+        ``('escape', None)``, ``('discard', None)``, or
+        ``('name', ident)`` for a trackable simple-name binding."""
+        ch, p = call, parents.get(call)
+        while p is not None:
+            if isinstance(p, ast.withitem):
+                return ("with" if p.context_expr is ch else "escape", None)
+            if isinstance(p, ast.Call):
+                # argument position (close_span(sp), self._watch(sp, ...))
+                return ("escape", None)
+            if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return ("escape", None)
+            if isinstance(p, ast.Assign):
+                if len(p.targets) == 1 \
+                        and isinstance(p.targets[0], ast.Name):
+                    return ("name", p.targets[0].id)
+                return ("escape", None)   # attr/subscript/tuple target
+            if isinstance(p, ast.Expr):
+                return ("discard", None)
+            if isinstance(p, (ast.IfExp, ast.BoolOp, ast.Await,
+                              ast.NamedExpr)):
+                ch, p = p, parents.get(p)   # x = a if c else open_span()
+                continue
+            # attribute read / comparison off the fresh value — give up
+            # tracking rather than guess (under-report)
+            return ("escape", None)
+        return ("escape", None)
+
+    def _name_is_settled(self, name, binder, scope, parents):
+        """True when some use of ``name`` after its binding closes or
+        escapes the span: call argument, method call on it, return /
+        yield, re-assignment, or a ``with`` entry."""
+        after = (binder.lineno, binder.col_offset)
+        for node in _walk_in_order(scope):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and (node.lineno, node.col_offset) > after):
+                continue
+            ch, p = node, parents.get(node)
+            while p is not None:
+                if isinstance(p, ast.Call):
+                    # arg of any call — close_span(sp) and every other
+                    # hand-off — or a method call sp.xxx(...) via func
+                    return True
+                if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom)):
+                    return True
+                if isinstance(p, ast.Assign) and p.value is ch:
+                    return True             # aliased / stored
+                if isinstance(p, ast.withitem) and p.context_expr is ch:
+                    return True
+                if isinstance(p, (ast.Attribute, ast.IfExp, ast.BoolOp,
+                                  ast.NamedExpr)):
+                    ch, p = p, parents.get(p)
+                    continue
+                break                       # plain read (compare, if sp:)
+        return False
+
+    def check(self, module: ParsedModule):
+        findings = []
+        for scope in self._scopes(module.tree):
+            parents = self._parents(scope)
+            for node in _walk_in_order(scope):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("span", "open_span")):
+                    continue
+                kind = node.func.attr
+                if kind == "span":
+                    recv = dotted_name(node.func.value).split(".")[-1]
+                    if not (recv in self._TRACERISH or "tracer" in recv):
+                        continue
+                use, ident = self._consumption(node, parents)
+                if use in ("with", "escape"):
+                    continue
+                if use == "name" and self._name_is_settled(
+                        ident, node, scope, parents):
+                    continue
+                if kind == "span":
+                    msg = (".span(...) returns a context manager — "
+                           "without `with` the span never even opens "
+                           "(use `with tracer.span(...)`)")
+                else:
+                    held = f" bound to '{ident}'" if ident else ""
+                    msg = (f"open_span(...) result{held} is never "
+                           "close_span'd and never escapes this scope — "
+                           "an unclosed span emits no trace record and "
+                           "orphans its children in the span tree")
+                findings.append(Finding(module.rel, node.lineno,
+                                        self.id, msg))
+        return findings
+
+
 #: registration order == report order for equal (file, line)
 ALL_RULES = (HostSyncRule(), PrngKeyReuseRule(), DtypeDisciplineRule(),
              RaiseDisciplineRule(), DonatedBufferReuseRule(),
-             NoBarePrintRule(), MetricsCatalogRule(), PallasCoverageRule())
+             NoBarePrintRule(), MetricsCatalogRule(), PallasCoverageRule(),
+             SpanLeakRule())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

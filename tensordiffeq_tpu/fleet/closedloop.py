@@ -64,6 +64,7 @@ from ..resilience.chaos import ChaosFault, active_chaos
 from ..resilience.retry import RetryPolicy
 from ..telemetry import default_registry, log_event
 from ..telemetry.slo import SLOSet
+from ..telemetry.tracing import active_tracer, propagate_trace
 
 
 class DriftMonitor:
@@ -337,10 +338,18 @@ class RetrainController:
         self._cycles += 1
         summary: dict = {"triggered": True, "tripped": list(tripped),
                          "cycle": self._cycles}
-        factory = self._retrain(summary)
-        v2 = self._export(factory, summary)
-        self._swap_all(factory, v2, summary)
-        return summary
+        tr = active_tracer()  # one probe on the untraced path
+        if tr is None:
+            factory = self._retrain(summary)
+            v2 = self._export(factory, summary)
+            self._swap_all(factory, v2, summary)
+            return summary
+        with tr.span("closedloop.cycle", cycle=self._cycles,
+                     tripped=len(tripped)):
+            factory = self._retrain(summary)
+            v2 = self._export(factory, summary)
+            self._swap_all(factory, v2, summary)
+            return summary
 
     # ------------------------------------------------------------------ #
     def _retrain(self, summary: dict):
@@ -365,17 +374,30 @@ class RetrainController:
                       generation=generation, members=factory.n_members,
                       start_epoch=done, target_epochs=self.retrain_iters,
                       relaunch=generation > 1)
+            tr = active_tracer()
+            gen_span = (None if tr is None else tr.open_span(
+                "closedloop.retrain", generation=generation,
+                start_epoch=done))
             try:
-                while done < self.retrain_iters:
-                    n = min(self.chunk, self.retrain_iters - done)
-                    factory.fit(tf_iter=n, chunk=n,
-                                resample_every=self.resample_every,
-                                **self.resample_kw)
-                    done += n
-                    chaos = active_chaos()
-                    if chaos is not None and done < self.retrain_iters:
-                        chaos.on_retrain_boundary(generation, done)
+                # the retrain job inherits the cycle's trace: anything
+                # this generation spawns (a cluster-backed factory, an
+                # export subprocess) reads TDQ_TRACE_CONTEXT and its
+                # spans join the incident timeline
+                with propagate_trace(gen_span):
+                    while done < self.retrain_iters:
+                        n = min(self.chunk, self.retrain_iters - done)
+                        factory.fit(tf_iter=n, chunk=n,
+                                    resample_every=self.resample_every,
+                                    **self.resample_kw)
+                        done += n
+                        chaos = active_chaos()
+                        if chaos is not None and done < self.retrain_iters:
+                            chaos.on_retrain_boundary(generation, done)
+                if gen_span is not None:
+                    tr.close_span(gen_span.set_attrs(end_epoch=done))
             except ChaosFault as e:
+                if gen_span is not None:
+                    tr.close_span(gen_span, error=e)
                 kills += 1
                 if kills >= self.retry.max_attempts:
                     raise

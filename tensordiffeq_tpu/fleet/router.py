@@ -248,6 +248,7 @@ class FleetRouter:
         self._registered: dict = {}
         self._loaded: "OrderedDict[str, LoadedTenant]" = OrderedDict()
         self._hits = self._misses = self._evictions = 0
+        self.collector = None  # set by serve_metrics
 
     # ------------------------------------------------------------------ #
     def register(self, tenant: str, artifact: str, *, f_model=None,
@@ -436,7 +437,31 @@ class FleetRouter:
         ``old_residual`` / ``new_residual`` / ``gate``,
         ``cutover_stall_s`` (flip-time flush stall; the only pause any
         waiter can observe), ``bit_identical`` (rejections only) and the
-        candidate's warm-start report."""
+        candidate's warm-start report.
+
+        With a tracer active the whole cutover is a ``fleet.hot_swap``
+        span — opened under whatever trace the caller carries, so a
+        retrain job's swap joins the retrain trace the
+        :class:`~tensordiffeq_tpu.fleet.RetrainController` propagated."""
+        tr = active_tracer()  # one probe on the untraced path
+        if tr is None:
+            return self._hot_swap(tenant, artifact, f_model=f_model,
+                                  net=net, probe_X=probe_X, gate=gate,
+                                  gate_ratio=gate_ratio)
+        with tr.span("fleet.hot_swap", tenant=str(tenant),
+                     artifact=str(artifact)) as sp:
+            verdict = self._hot_swap(tenant, artifact, f_model=f_model,
+                                     net=net, probe_X=probe_X, gate=gate,
+                                     gate_ratio=gate_ratio)
+            sp.set_attrs(swapped=bool(verdict.get("swapped")),
+                         reason=str(verdict.get("reason")))
+            if not verdict.get("swapped"):
+                sp.status = "error"
+            return verdict
+
+    def _hot_swap(self, tenant: str, artifact: str, *, f_model=None,
+                  net=None, probe_X=None, gate: Optional[float] = None,
+                  gate_ratio: float = 1.0) -> dict:
         reg = self._reg(tenant)
         old = self.load(tenant)
         verdict: dict = {"tenant": str(tenant), "swapped": False,
@@ -614,6 +639,32 @@ class FleetRouter:
 
     def pending_points(self) -> int:
         return sum(lt.pending_points() for lt in self._loaded.values())
+
+    # ------------------------------------------------------------------ #
+    def serve_metrics(self, addr: str = "127.0.0.1", port: int = 0, *,
+                      slos=None, run_dirs: Sequence[str] = (),
+                      host: Optional[str] = None):
+        """One-call observability mount: a
+        :class:`~tensordiffeq_tpu.telemetry.Collector` exposing this
+        router's registry (every ``fleet.*`` / ``serving.*`` instrument,
+        per-tenant labels included) plus any ``run_dirs`` to tail,
+        served at ``/metrics`` + ``/healthz``.  ``/healthz`` evaluates
+        ``slos`` (default: this router's own :class:`SLOSet`) over the
+        merged fleet view.  Returns the collector (its ``.url`` is the
+        scrape target); caller closes it."""
+        import os as _os
+        import socket as _socket
+
+        from ..telemetry.collector import Collector
+        label = host if host is not None else _socket.gethostname()
+        c = Collector(slos=slos if slos is not None else self.slo)
+        c.attach_registry(self._registry, host=label,
+                          process=f"router:{_os.getpid()}")
+        for d in run_dirs:
+            c.watch(d, host=label)
+        c.serve(addr, port)
+        self.collector = c
+        return c
 
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:

@@ -323,6 +323,11 @@ class Chaos:
                           f"exiting at {phase} epoch {epoch}",
                           level="warning", verbose=False, fault="host_loss",
                           phase=phase, epoch=epoch, rank=rank)
+                # os._exit bypasses atexit AND signal handlers — the
+                # explicit flush below is the only way the flight
+                # recorder's ring (this worker's final chunk) hits disk
+                from ..telemetry.flight import flush_flight
+                flush_flight("host_loss")
                 import sys
                 sys.stdout.flush()
                 sys.stderr.flush()

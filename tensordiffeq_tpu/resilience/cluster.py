@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
 from ..telemetry import default_registry, log_event
+from ..telemetry.tracing import TRACE_CONTEXT_ENV
 
 _HB_ENV = "TDQ_HEARTBEAT_FILE"
 _hb_cache = {"checked": False, "path": None}
@@ -220,6 +221,7 @@ class ClusterSupervisor:
         self.tracer = tracer
         self.registry = registry if registry is not None else default_registry()
         self.verbose = bool(verbose)
+        self.collector = None  # set by serve_metrics
         os.makedirs(self.workdir, exist_ok=True)
 
     # ------------------------------------------------------------------ #
@@ -238,6 +240,14 @@ class ClusterSupervisor:
             env[_HB_ENV] = hb
             env["TDQ_CLUSTER_GENERATION"] = str(gen)
             env["TDQ_CLUSTER_NPROC"] = str(nproc)
+            if self.tracer is not None:
+                # cross-process trace context: the open cluster.launch
+                # span becomes the parent of every worker-side root, so
+                # cluster.launch > host.join > train.step is ONE trace
+                # across the supervisor and all generations' workers
+                ctx = self.tracer.context()
+                if ctx:
+                    env[TRACE_CONTEXT_ENV] = ctx
             argv = [str(a) for a in self.worker_cmd(pid, nproc, port)]
             # stderr/stdout go to FILES, not pipes: the supervisor never
             # reads them inline, so a chatty worker cannot fill a pipe and
@@ -284,6 +294,27 @@ class ClusterSupervisor:
             return ""
 
     # ------------------------------------------------------------------ #
+    def serve_metrics(self, addr: str = "127.0.0.1", port: int = 0, *,
+                      slos=None, run_dirs: Sequence[str] = (),
+                      host: Optional[str] = None):
+        """One-call observability mount: a
+        :class:`~tensordiffeq_tpu.telemetry.Collector` exposing this
+        supervisor's registry (live ``cluster.*`` metrics) plus any
+        worker ``run_dirs`` it should tail, served at
+        ``/metrics`` + ``/healthz``.  Returns the collector (its
+        ``.url`` is the scrape target); caller closes it."""
+        from ..telemetry.collector import Collector
+        label = host if host is not None else socket.gethostname()
+        c = Collector(slos=slos)
+        c.attach_registry(self.registry, host=label,
+                          process=f"supervisor:{os.getpid()}")
+        for d in run_dirs:
+            c.watch(d, host=label)
+        c.serve(addr, port)
+        self.collector = c
+        return c
+
+    # ------------------------------------------------------------------ #
     def run(self, timeout_s: float = 600.0) -> ClusterResult:
         """Drive the job to completion (all workers exit 0), relaunching
         through host losses; raises :class:`HostLost` when the relaunch
@@ -292,12 +323,14 @@ class ClusterSupervisor:
         deadline = time.monotonic() + float(timeout_s)
         gen, nproc = 0, self.nproc
         t_lost: Optional[float] = None  # detection time of the last loss
+        job_trace: Optional[str] = None  # one trace across ALL generations
         while True:
             launch_span = None
             if self.tracer is not None:
                 launch_span = self.tracer.open_span(
-                    "cluster.launch", parent=None, generation=gen,
-                    nproc=nproc)
+                    "cluster.launch", parent=None, trace_id=job_trace,
+                    generation=gen, nproc=nproc)
+                job_trace = launch_span.trace_id
             workers, port = self._spawn_generation(gen, nproc)
             report = GenerationReport(gen, nproc, port)
             t0 = time.monotonic()

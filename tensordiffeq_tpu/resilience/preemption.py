@@ -162,6 +162,8 @@ def handle_preemption(exc: Preempted, logger=None,
     log_event("preempt", f"exiting resumable (status {RESUMABLE_EXIT_CODE}) "
               f"after {exc}", verbose=True, level="warning",
               status=RESUMABLE_EXIT_CODE, phase=exc.phase, epoch=exc.epoch)
+    from ..telemetry.flight import flush_flight
+    flush_flight("preempted", error=exc)
     if logger is not None:
         logger.close()
     if exit_process:

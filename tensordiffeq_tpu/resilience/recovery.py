@@ -244,6 +244,8 @@ class ResilientFit:
                            grad_clip=self._grad_clip_active, **fit_kw)
                 break
             except TrainingDiverged as e:
+                from ..telemetry.flight import flush_flight
+                flush_flight("training_diverged", error=e)
                 retries += 1
                 self.recoveries += 1
                 last_exc = e

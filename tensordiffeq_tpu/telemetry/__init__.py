@@ -35,9 +35,25 @@ shared observability layer for training, serving, and bench:
   (serving p99, shed/timeout fractions, step-time regression) evaluated
   against registry state with burn rates, plus the Prometheus text
   exposition of the whole registry.
+* :class:`Collector` (:mod:`~tensordiffeq_tpu.telemetry.collector`) —
+  the fleet-level plane: tails N run dirs (torn-line-tolerant, resumable
+  offsets across rotation), merges every source's metrics under
+  ``host``/``process`` labels, evaluates the :class:`SLOSet` fleet-wide,
+  and serves ``/metrics`` + ``/healthz`` from a stdlib HTTP endpoint
+  that :meth:`FleetRouter.serve_metrics
+  <tensordiffeq_tpu.fleet.FleetRouter.serve_metrics>` and
+  :meth:`ClusterSupervisor.serve_metrics
+  <tensordiffeq_tpu.resilience.ClusterSupervisor.serve_metrics>` mount
+  with one call.
+* :class:`FlightRecorder` (:mod:`~tensordiffeq_tpu.telemetry.flight`) —
+  the crash flight recorder: a bounded ring of this process's most
+  recent events/spans, flushed to ``flight.jsonl`` from the
+  divergence/preemption/chaos failure paths and an atexit/signal
+  backstop, so a killed worker leaves its final moments on disk.
 * :func:`report` / :func:`summarize` — render a run directory's JSONL
   into a human diagnosis (divergence point, λ saturation, slowest phase,
-  memory peak, slowest traces, SLO verdict).
+  memory peak, slowest traces, SLO verdict, the FLIGHT narration of a
+  dead process's last trace).
 
 Typical use::
 
@@ -57,12 +73,17 @@ percentiles) into :func:`default_registry` unless given their own, and
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, MetricsScope, default_registry)
 from .runlog import (EVENTS_FILE, MANIFEST_FILE,  # noqa: F401
-                     SCHEMA_VERSION, RunLogger, active_logger, log_event,
-                     read_events, read_manifest)
-from . import costmodel, slo, tracing  # noqa: F401
-from .tracing import (Span, Tracer, active_tracer,  # noqa: F401
-                      attach_trace, current_trace_id, to_perfetto)
+                     SCHEMA_VERSION, RunLogger, active_logger,
+                     event_segments, log_event, read_events, read_manifest)
+from . import collector, costmodel, flight, slo, tracing  # noqa: F401
+from .tracing import (TRACE_CONTEXT_ENV, Span, Tracer,  # noqa: F401
+                      active_tracer, attach_trace, current_trace_id,
+                      propagate_trace, to_perfetto)
+from .collector import Collector  # noqa: F401
 from .costmodel import StepCostModel  # noqa: F401
+from .flight import (FLIGHT_FILE, FlightRecorder,  # noqa: F401
+                     active_flight_recorder, flight_sections, flush_flight,
+                     read_flight)
 from .slo import SLOSet, to_prometheus  # noqa: F401
 from .hooks import (TrainingDiverged, TrainingTelemetry,  # noqa: F401
                     as_training_telemetry, lambda_summaries)

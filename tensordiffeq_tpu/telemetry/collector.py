@@ -1,0 +1,337 @@
+"""Fleet-level metrics collector: N run dirs + live registries → one
+``/metrics``.
+
+Per-process telemetry stops being enough the moment the system spans
+processes: a supervised cluster writes one run dir per worker per
+generation, the closed-loop fleet another per retrain job, and "how is
+the fleet doing" means reading all of them *while they are being
+written*.  A :class:`Collector`:
+
+* **tails** run dirs (:meth:`watch`) torn-line-tolerantly with resumable
+  byte offsets — only complete (newline-terminated) lines are consumed,
+  a partial tail is left for the next poll, and rotation
+  (``events.jsonl`` → ``events.jsonl.<n>``, see
+  :class:`~tensordiffeq_tpu.telemetry.RunLogger`) is followed without
+  re-reading or losing records because sealed segments are
+  rename-stable;
+* **attaches** live in-process registries (:meth:`attach_registry`) —
+  what :meth:`FleetRouter.serve_metrics
+  <tensordiffeq_tpu.fleet.FleetRouter.serve_metrics>` and
+  :meth:`ClusterSupervisor.serve_metrics
+  <tensordiffeq_tpu.resilience.ClusterSupervisor.serve_metrics>` mount;
+* **merges** every source's metrics into one snapshot re-keyed under
+  ``host``/``process`` labels, so the existing
+  :func:`~tensordiffeq_tpu.telemetry.to_prometheus` exposition and
+  :class:`~tensordiffeq_tpu.telemetry.SLOSet` (whose aggregations
+  already sum/worst-case across labels) evaluate fleet-wide unchanged;
+* **serves** both over a stdlib ``http.server`` endpoint
+  (:meth:`serve`): ``/metrics`` (Prometheus text exposition 0.0.4) and
+  ``/healthz`` (the SLO verdict JSON, HTTP 200/503 + an ``exit_status``
+  field mirroring the ``bench.py --slo`` gate).
+
+Usage::
+
+    c = telemetry.Collector()
+    c.watch("runs/worker0", host="host-a").watch("runs/worker1",
+                                                 host="host-b")
+    c.poll()
+    print(c.metrics_text())          # or c.serve(); GET <url>/metrics
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .registry import MetricsRegistry, _key
+from .runlog import EVENTS_FILE, MANIFEST_FILE, event_segments
+from .slo import SLOSet, _parse_key, to_prometheus
+
+
+class _Tail:
+    """Resumable multi-segment tail of one run dir's event files.
+
+    State is two numbers: how many sealed (rotated) segments are fully
+    consumed, and the byte offset into the first unconsumed file.  A
+    rotation between polls just turns the partially-consumed live file
+    into the next sealed segment — same bytes, same offset — so nothing
+    is re-read and nothing is skipped."""
+
+    def __init__(self, run_dir: str, host: str, process: str):
+        self.run_dir = str(run_dir)
+        self.host = str(host)
+        self.process = str(process)
+        self._n_sealed = 0
+        self._offset = 0
+
+    def poll(self):
+        """(new complete records, torn/undecodable line count)."""
+        base = os.path.join(self.run_dir, EVENTS_FILE)
+        segs = event_segments(self.run_dir)
+        if segs and segs[-1] == base:
+            sealed, live = segs[:-1], base
+        else:
+            sealed, live = segs, None
+        recs: list = []
+        torn = 0
+        for i in range(self._n_sealed, len(sealed)):
+            r, t = self._consume(sealed[i], final=True)
+            recs += r
+            torn += t
+            self._n_sealed += 1
+            self._offset = 0
+        if live is not None:
+            r, t = self._consume(live, final=False)
+            recs += r
+            torn += t
+        return recs, torn
+
+    def _consume(self, path: str, final: bool):
+        """Read complete lines from ``path`` starting at the current
+        offset.  ``final`` (a sealed segment, which never grows again):
+        a trailing partial line is torn, not pending."""
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                if size < self._offset:
+                    self._offset = 0  # truncated/replaced: start over
+                fh.seek(self._offset)
+                data = fh.read()
+        except OSError:
+            return [], 0
+        cut = data.rfind(b"\n") + 1
+        pending = data[cut:]
+        data = data[:cut]
+        self._offset += len(data)
+        recs, torn = [], 0
+        for line in data.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line.decode("utf-8")))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                torn += 1
+        if final and pending:
+            torn += 1  # a sealed segment's partial tail is gone for good
+        return recs, torn
+
+
+class Collector:
+    """Fleet-level telemetry aggregator + HTTP endpoint (see module
+    docstring).
+
+    Args:
+      slos: the objective set ``/healthz`` evaluates fleet-wide
+        (default: :meth:`SLOSet.default`).
+      registry: destination for the collector's own ``collector.*``
+        instruments (default: a private registry, merged into the
+        exposition alongside the sources).
+      max_events: bound on the merged recent-event deque the SLO
+        trail objectives read.
+      clock: wall-clock source (injectable for tests).
+    """
+
+    def __init__(self, slos: Optional[SLOSet] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_events: int = 20_000, clock=time.time):
+        self.slos = slos if slos is not None else SLOSet.default()
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self._clock = clock
+        self._tails: list = []
+        self._registries: list = []
+        self._manifest_metrics: dict = {}
+        self.events: deque = deque(maxlen=int(max_events))
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    # ------------------------------------------------------------------ #
+    # sources
+    # ------------------------------------------------------------------ #
+    def watch(self, run_dir: str, host: str = "local",
+              process: Optional[str] = None) -> "Collector":
+        """Tail ``run_dir`` under the given ``host``/``process`` labels
+        (process defaults to the dir's basename).  Chainable."""
+        if process is None:
+            process = os.path.basename(os.path.normpath(str(run_dir)))
+        self._tails.append(_Tail(run_dir, host, process))
+        self._sources_gauge()
+        return self
+
+    def attach_registry(self, registry, host: str = "local",
+                        process: Optional[str] = None) -> "Collector":
+        """Merge a live in-process registry (anything with ``as_dict()``)
+        into the exposition under ``host``/``process`` labels.
+        Chainable."""
+        if process is None:
+            process = f"pid{os.getpid()}"
+        self._registries.append((registry, str(host), str(process)))
+        self._sources_gauge()
+        return self
+
+    def _sources_gauge(self):
+        self.registry.gauge("collector.sources").set(
+            len(self._tails) + len(self._registries))
+
+    # ------------------------------------------------------------------ #
+    # polling + merging
+    # ------------------------------------------------------------------ #
+    def poll(self) -> int:
+        """Drain every tail (and refresh manifest metric snapshots);
+        returns the number of new records merged."""
+        with self._lock:
+            n_new = 0
+            for tail in self._tails:
+                recs, torn = tail.poll()
+                n_new += len(recs)
+                for rec in recs:
+                    self.events.append(rec)
+                if recs:
+                    self.registry.counter(
+                        "collector.events", host=tail.host,
+                        process=tail.process).inc(len(recs))
+                if torn:
+                    self.registry.counter(
+                        "collector.torn_lines", host=tail.host,
+                        process=tail.process).inc(torn)
+                snap = self._read_manifest_metrics(tail.run_dir)
+                if snap:
+                    self._manifest_metrics[(tail.host, tail.process)] = snap
+            self.registry.counter("collector.polls").inc()
+            return n_new
+
+    @staticmethod
+    def _read_manifest_metrics(run_dir: str) -> Optional[dict]:
+        """A run's closing metrics snapshot (present once its RunLogger
+        finalized; None while it is still live or after a kill)."""
+        try:
+            with open(os.path.join(str(run_dir), MANIFEST_FILE)) as fh:
+                return json.load(fh).get("metrics") or None
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def merged_metrics(self) -> dict:
+        """One ``as_dict()``-shaped snapshot of every source, each key
+        re-rendered with its source's ``host``/``process`` labels merged
+        in (the collector's own instruments go in as-is — they already
+        carry their labels)."""
+        merged: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+
+        def graft(snapshot: dict, host: str, process: str):
+            for group in ("counters", "gauges", "histograms"):
+                for key, v in (snapshot.get(group) or {}).items():
+                    name, labels = _parse_key(key)
+                    labels["host"] = host
+                    labels["process"] = process
+                    merged[group][_key(name, labels)] = v
+
+        with self._lock:
+            for (host, process), snap in self._manifest_metrics.items():
+                graft(snap, host, process)
+            for reg, host, process in self._registries:
+                graft(reg.as_dict(), host, process)
+            own = self.registry.as_dict()
+        for group in ("counters", "gauges", "histograms"):
+            merged[group].update(own.get(group) or {})
+        return merged
+
+    # ------------------------------------------------------------------ #
+    # the two endpoints (callable without the HTTP server too)
+    # ------------------------------------------------------------------ #
+    def metrics_text(self) -> str:
+        """The fleet's merged metrics in Prometheus text exposition."""
+        return to_prometheus(self.merged_metrics())
+
+    def healthz(self) -> dict:
+        """The fleet-wide SLO verdict over the merged metrics and the
+        merged event trail, plus ``exit_status`` (0 ok / 3 breach —
+        mirroring the ``bench.py --slo`` CI gate) and a source census."""
+        verdict = self.slos.evaluate(self.merged_metrics(),
+                                     list(self.events))
+        verdict["exit_status"] = 0 if verdict["ok"] else 3
+        verdict["sources"] = {"run_dirs": len(self._tails),
+                              "registries": len(self._registries)}
+        return verdict
+
+    # ------------------------------------------------------------------ #
+    # HTTP
+    # ------------------------------------------------------------------ #
+    def serve(self, addr: str = "127.0.0.1", port: int = 0) -> str:
+        """Start the endpoint on a daemon thread (``port=0``: ephemeral)
+        and return its URL.  Each GET re-polls first, so a scrape always
+        sees the latest complete lines."""
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        collector.poll()
+                        body = collector.metrics_text().encode("utf-8")
+                        code = 200
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif path == "/healthz":
+                        collector.poll()
+                        verdict = collector.healthz()
+                        body = (json.dumps(verdict, indent=1)
+                                + "\n").encode("utf-8")
+                        code = 200 if verdict["ok"] else 503
+                        ctype = "application/json"
+                    else:
+                        body, code = b"not found\n", 404
+                        ctype = "text/plain"
+                except Exception as e:  # a scrape must never kill the fleet
+                    body = f"{type(e).__name__}: {e}\n".encode("utf-8")
+                    code, ctype = 500, "text/plain"
+                # clamp unknown paths: label cardinality must not be
+                # attacker- (or typo-) controlled
+                ep = path if path in ("/metrics", "/healthz") else "other"
+                collector.registry.counter("collector.scrapes",
+                                           endpoint=ep).inc()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass  # bench workers' stdout is a JSON-line protocol
+
+        self._httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tdq-collector",
+                                        daemon=True)
+        self._thread.start()
+        return self.url
+
+    @property
+    def url(self) -> Optional[str]:
+        if self._httpd is None:
+            return None
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Collector":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,257 @@
+"""Crash flight recorder: a process's final moments, on disk.
+
+The run log already flushes per event, but the *interesting* records of
+a dying process — which chunk it was in, which spans were open, what the
+last heartbeat said — are scattered through a log that may be megabytes
+long, live in another process's run dir, or (for a worker that never
+attached a :class:`~tensordiffeq_tpu.telemetry.RunLogger`) nowhere at
+all.  A :class:`FlightRecorder` keeps a bounded in-memory ring of the
+most recent events/spans this process appended to ANY run logger (it
+rides the runlog tap, so spans — ``trace`` events — are captured too)
+and, on the failure paths, dumps the ring to ``flight.jsonl``:
+
+* the chaos ``host_loss_at`` hard-kill calls :func:`flush_flight` just
+  before ``os._exit`` (which bypasses atexit and signal handlers — the
+  explicit call is the only way the ring survives);
+* :class:`~tensordiffeq_tpu.resilience.ResilientFit` flushes on every
+  ``TrainingDiverged`` it catches, and
+  :func:`~tensordiffeq_tpu.resilience.handle_preemption` on the
+  ``Preempted`` exit path;
+* :meth:`FlightRecorder.install` adds a ``faulthandler``-style atexit
+  hook (and optional chaining signal handlers) for everything else.
+
+``flight.jsonl`` is append-only: each flush writes a ``flight.flush``
+header record (reason, pid, ring depth, optional error) followed by the
+ring's contents, so repeated incidents in one process stack up as
+sections and :func:`flight_sections` reads them back torn-line-tolerant.
+``telemetry.report`` narrates the final section as the FLIGHT block.
+
+Usage (worker side)::
+
+    with telemetry.RunLogger(run_dir) as run, \\
+            telemetry.FlightRecorder(run_dir=run_dir) as fr:
+        fr.install()               # atexit backstop
+        solver.fit(..., telemetry=run)
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import signal as _signal
+import time
+from typing import Any, Optional
+
+from . import runlog
+from .registry import default_registry
+
+FLIGHT_FILE = "flight.jsonl"
+
+# innermost-wins stack, same discipline as the runlog/tracer
+_ACTIVE: list = []
+
+
+def active_flight_recorder() -> Optional["FlightRecorder"]:
+    """The innermost entered :class:`FlightRecorder`, or None — one list
+    peek, the whole disabled-path cost at every flush site."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def flush_flight(reason: str, error: Optional[BaseException] = None,
+                 run_dir: Optional[str] = None) -> Optional[str]:
+    """Flush the active flight recorder's ring (no-op without one).
+    This is what the divergence/preemption/chaos failure paths call —
+    they never need to know whether a recorder is attached."""
+    fr = active_flight_recorder()
+    if fr is None:
+        return None
+    return fr.flush(reason, error=error, run_dir=run_dir)
+
+
+class FlightRecorder:
+    """Bounded ring of this process's most recent telemetry records.
+
+    Args:
+      run_dir: default destination directory for ``flight.jsonl``
+        (None: resolved at flush time from the active run logger).
+      capacity: ring depth — how many final records a flush preserves.
+      registry: metrics destination for the ``flight.flushes`` counter
+        (None: the process-wide default registry, resolved at flush).
+      clock: wall-clock source (injectable for tests).
+
+    As a context manager the recorder taps every
+    :class:`~tensordiffeq_tpu.telemetry.RunLogger` append in the process
+    and becomes the target of :func:`flush_flight`; an exception
+    propagating out of the block flushes the ring with
+    ``reason="exception"`` before re-raising.
+    """
+
+    def __init__(self, run_dir: Optional[str] = None, capacity: int = 256,
+                 registry=None, clock=time.time):
+        self.run_dir = str(run_dir) if run_dir is not None else None
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._registry = registry
+        self._clock = clock
+        self.n_seen = 0
+        self.n_flushes = 0
+        self._installed = False
+        self._disarmed = False
+
+    # ------------------------------------------------------------------ #
+    def observe(self, rec: dict):
+        """Ring one record (the runlog tap target)."""
+        self._ring.append(rec)
+        self.n_seen += 1
+
+    def __enter__(self) -> "FlightRecorder":
+        _ACTIVE.append(self)
+        runlog._TAPS.append(self.observe)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            try:
+                self.flush("exception", error=exc)
+            except Exception:
+                pass  # never mask the real failure
+        try:
+            _ACTIVE.remove(self)
+        except ValueError:
+            pass
+        try:
+            runlog._TAPS.remove(self.observe)
+        except ValueError:
+            pass
+        return False
+
+    # ------------------------------------------------------------------ #
+    def install(self, signals: tuple = ()) -> "FlightRecorder":
+        """Arm the ``faulthandler``-style backstop: an atexit hook that
+        flushes the ring unless :meth:`disarm` ran first (a clean run
+        leaves no flight file), plus optional chaining handlers for
+        ``signals`` — each flushes ``signal:<n>`` then defers to the
+        previous handler (or re-raises the default action), so a
+        :class:`~tensordiffeq_tpu.resilience.PreemptionHandler` already
+        owning SIGTERM keeps working.  Note ``os._exit`` bypasses both —
+        the chaos host-loss path flushes explicitly for exactly that
+        reason."""
+        if not self._installed:
+            self._installed = True
+            atexit.register(self._atexit_flush)
+        for sig in signals:
+            prev = _signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                try:
+                    self.flush(f"signal:{signum}")
+                except Exception:
+                    pass
+                if callable(_prev):
+                    _prev(signum, frame)
+                elif _prev == _signal.SIG_DFL:
+                    _signal.signal(signum, _signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            _signal.signal(sig, _handler)
+        return self
+
+    def disarm(self):
+        """Mark the run as cleanly finished: the installed atexit hook
+        becomes a no-op."""
+        self._disarmed = True
+
+    def _atexit_flush(self):
+        if self._disarmed or self.n_flushes or not len(self._ring):
+            return
+        try:
+            self.flush("atexit")
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ #
+    def flush(self, reason: str, error: Optional[BaseException] = None,
+              run_dir: Optional[str] = None) -> Optional[str]:
+        """Append a ``flight.flush`` header + the ring's contents to
+        ``<run_dir>/flight.jsonl``, fsynced so the bytes survive an
+        ``os._exit`` on the next line.  Returns the path written, or
+        None when no destination directory can be resolved."""
+        target = run_dir if run_dir is not None else self.run_dir
+        if target is None:
+            lg = runlog.active_logger()
+            target = lg.run_dir if lg is not None else None
+        if target is None:
+            return None
+        header: dict = {"v": runlog.SCHEMA_VERSION,
+                        "t": round(self._clock(), 6),
+                        "kind": "flight.flush", "reason": str(reason),
+                        "pid": os.getpid(), "n_records": len(self._ring),
+                        "n_seen": self.n_seen}
+        if error is not None:
+            header["error"] = f"{type(error).__name__}: {error}"
+        os.makedirs(str(target), exist_ok=True)
+        path = os.path.join(str(target), FLIGHT_FILE)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(runlog._sanitize(header), allow_nan=False,
+                                default=runlog._json_default) + "\n")
+            for rec in list(self._ring):
+                try:
+                    fh.write(json.dumps(runlog._sanitize(rec),
+                                        allow_nan=False,
+                                        default=runlog._json_default) + "\n")
+                except (TypeError, ValueError):
+                    continue  # one bad record never aborts the dump
+            fh.flush()
+            os.fsync(fh.fileno())
+        self.n_flushes += 1
+        reg = (self._registry if self._registry is not None
+               else default_registry())
+        try:
+            reg.counter("flight.flushes", reason=str(reason)).inc()
+        except Exception:
+            pass
+        return path
+
+
+# -------------------------------------------------------------------------- #
+# reading flight files back
+# -------------------------------------------------------------------------- #
+def read_flight(run_dir: str) -> list:
+    """All records of ``<run_dir>/flight.jsonl`` in append order
+    (``flight.flush`` headers interleaved with ringed events); torn or
+    undecodable lines are skipped, same salvage stance as the runlog."""
+    out: list = []
+    path = os.path.join(str(run_dir), FLIGHT_FILE)
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def flight_sections(run_dir: str) -> list:
+    """The flight file parsed into flush sections:
+    ``[{"header": <flight.flush rec>, "records": [...]}, ...]`` in
+    flush order — the last section is the process's final moments, the
+    one the report's FLIGHT block narrates."""
+    sections: list = []
+    for rec in read_flight(run_dir):
+        if rec.get("kind") == "flight.flush":
+            sections.append({"header": rec, "records": []})
+        elif sections:
+            sections[-1]["records"].append(rec)
+        else:  # torn header: keep the orphan records readable anyway
+            sections.append({"header": {"kind": "flight.flush",
+                                        "reason": "unknown"},
+                             "records": [rec]})
+    return sections

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .flight import flight_sections
 from .runlog import NONFINITE_TOKENS, read_events, read_manifest
 from .slo import SLOSet
 from .tracing import slowest_root, span_tree
@@ -147,6 +148,9 @@ def summarize(run_dir: str) -> dict:
                                  if e.get("reason")],
         "warmstarts": [e for e in of_kind("warmstart")
                        if e.get("wall_s") is not None],
+        # flight recorder (PR 19): the dead process's final moments —
+        # flush sections of <run_dir>/flight.jsonl, last one narrated
+        "flight": flight_sections(run_dir),
     }
 
 
@@ -398,6 +402,43 @@ def report(run_dir: str, width: int = 72) -> str:
             lines.append(f"  {len(errs)} span(s) ended in error; first: "
                          f"{errs[0].get('name')} trace {errs[0].get('trace')}"
                          f" ({_fmt(errs[0].get('error'))})")
+
+    # -- flight recorder: a dead process's final moments ---------------- #
+    if s["flight"]:
+        last = s["flight"][-1]
+        hdr, recs = last["header"], last["records"]
+        lines.append(
+            f"FLIGHT: {len(s['flight'])} flush(es) in flight.jsonl; last "
+            f"from pid {_fmt(hdr.get('pid'))} "
+            f"(reason: {_fmt(hdr.get('reason'))}, {len(recs)} record(s)"
+            + (f", error: {_fmt(hdr.get('error'))}"
+               if hdr.get("error") else "") + ")")
+        kinds: dict = {}
+        for r in recs:
+            k = str(r.get("kind", "?"))
+            kinds[k] = kinds.get(k, 0) + 1
+        if kinds:
+            lines.append("  ring held: " + ", ".join(
+                f"{k} x{n}" for k, n in sorted(kinds.items())))
+        final_spans = [r for r in recs if r.get("kind") == "trace"]
+        if final_spans:
+            fs = final_spans[-1]
+            attrs = fs.get("attrs") or {}
+            extras = ", ".join(f"{k}={_fmt(v)}"
+                               for k, v in sorted(attrs.items()))
+            lines.append(
+                f"  final span: {fs.get('name')} "
+                f"(trace {fs.get('trace')}"
+                + (f"; {extras}" if extras else "")
+                + (", status error" if fs.get("status") == "error" else "")
+                + ") — the last thing this process finished")
+        final_events = [r for r in recs if r.get("kind") != "trace"]
+        if final_events:
+            fe_rec = final_events[-1]
+            msg = fe_rec.get("message")
+            lines.append(
+                f"  final event: [{fe_rec.get('kind')}]"
+                + (f" {msg}" if msg else ""))
 
     # -- SLO verdict ---------------------------------------------------- #
     slo = s["slo"]

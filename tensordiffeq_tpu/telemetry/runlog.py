@@ -45,6 +45,11 @@ MANIFEST_FILE = "manifest.json"
 # harness that keeps its own log) resolve to the innermost logger
 _ACTIVE: list = []
 
+# event taps: callables fed every record any RunLogger appends, AFTER the
+# disk write.  The flight recorder's ring rides this hook; taps must
+# never raise into the logging path and are called best-effort.
+_TAPS: list = []
+
 
 def active_logger() -> Optional["RunLogger"]:
     """The innermost attached :class:`RunLogger`, or None."""
@@ -127,7 +132,8 @@ class RunLogger:
 
     def __init__(self, run_dir: str, config: Optional[dict] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 run_id: Optional[str] = None, clock=time.time):
+                 run_id: Optional[str] = None, clock=time.time,
+                 rotate_bytes: Optional[int] = None):
         self.run_dir = str(run_dir)
         os.makedirs(self.run_dir, exist_ok=True)
         self.registry = registry if registry is not None else default_registry()
@@ -135,6 +141,13 @@ class RunLogger:
         self.run_id = run_id or f"run-{os.getpid()}-{int(clock() * 1e3):x}"
         self.n_events = 0
         self._closed = False
+        # size-based rotation: when the live file crosses the cap it is
+        # renamed to the next `events.jsonl.<n>` segment (``.1`` oldest)
+        # and a fresh live file opened.  Rotated segments are final —
+        # never renamed again — so a collector tailing by (segment,
+        # offset) keeps valid offsets across rotations.
+        self.rotate_bytes = int(rotate_bytes) if rotate_bytes else None
+        self.n_rotations = 0
         self._manifest = {
             "schema_version": SCHEMA_VERSION,
             "run_id": self.run_id,
@@ -174,10 +187,36 @@ class RunLogger:
         rec = {"v": SCHEMA_VERSION, "t": round(self._clock(), 6),
                "kind": str(kind)}
         rec.update(fields)
-        self._fh.write(json.dumps(_sanitize(rec), allow_nan=False,
+        rec = _sanitize(rec)
+        self._fh.write(json.dumps(rec, allow_nan=False,
                                   default=_json_default) + "\n")
         self._fh.flush()
         self.n_events += 1
+        if self.rotate_bytes is not None \
+                and self._fh.tell() >= self.rotate_bytes:
+            self._rotate()
+        if _TAPS:  # flight recorders ride every append, best-effort
+            for tap in list(_TAPS):
+                try:
+                    tap(rec)
+                except Exception:
+                    pass
+
+    def _rotate(self):
+        """Seal the live file as the next ``events.jsonl.<n>`` segment
+        and open a fresh one.  Suffixes only ever grow (``.1`` is the
+        oldest), so sealed segments stay byte-stable for tailing
+        readers."""
+        self._fh.close()
+        nxt = 1
+        for p in event_segments(self.run_dir)[:-1]:
+            suf = p.rsplit(".", 1)[-1]
+            if suf.isdigit():
+                nxt = max(nxt, int(suf) + 1)
+        os.replace(os.path.join(self.run_dir, EVENTS_FILE),
+                   os.path.join(self.run_dir, f"{EVENTS_FILE}.{nxt}"))
+        self._fh = open(os.path.join(self.run_dir, EVENTS_FILE), "a")
+        self.n_rotations += 1
 
     def close(self):
         """Finalize: flush the sink and rewrite the manifest with the end
@@ -188,6 +227,8 @@ class RunLogger:
         self._fh.close()
         self._manifest["ended"] = self._clock()
         self._manifest["n_events"] = self.n_events
+        if self.n_rotations:
+            self._manifest["n_rotations"] = self.n_rotations
         try:
             self._manifest["metrics"] = self.registry.as_dict()
         except Exception:
@@ -211,23 +252,49 @@ def read_manifest(run_dir: str) -> dict:
         return json.load(fh)
 
 
+def event_segments(run_dir: str) -> list:
+    """The run's event files in append order: rotated segments
+    (``events.jsonl.1`` oldest → highest suffix newest), then the live
+    ``events.jsonl``.  Every multi-segment reader — :func:`read_events`,
+    the collector's tails — iterates this."""
+    run_dir = str(run_dir)
+    base = os.path.join(run_dir, EVENTS_FILE)
+    rotated = []
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        names = []
+    for n in names:
+        if n.startswith(EVENTS_FILE + "."):
+            suf = n[len(EVENTS_FILE) + 1:]
+            if suf.isdigit():
+                rotated.append((int(suf), os.path.join(run_dir, n)))
+    segs = [p for _, p in sorted(rotated)]
+    if os.path.exists(base):
+        segs.append(base)
+    return segs
+
+
 def read_events(run_dir: str, kind: Optional[str] = None) -> list:
-    """Parse ``events.jsonl`` back into dicts (optionally one ``kind``).
-    A truncated final line (process killed mid-write) is skipped, not
-    fatal — same salvage stance as ``bench.last_json_line``."""
+    """Parse the run's events back into dicts (optionally one ``kind``),
+    reading seamlessly across rotated segments.  A truncated final line
+    (process killed mid-write) is skipped per segment, not fatal — same
+    salvage stance as ``bench.last_json_line``."""
     out = []
-    path = os.path.join(run_dir, EVENTS_FILE)
-    if not os.path.exists(path):
-        return out
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if kind is None or rec.get("kind") == kind:
-                out.append(rec)
+    for path in event_segments(run_dir):
+        try:
+            fh = open(path)
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if kind is None or rec.get("kind") == kind:
+                    out.append(rec)
     return out

@@ -32,6 +32,7 @@ Spans use wall-clock start times (Perfetto timeline placement) and
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -44,6 +45,13 @@ from .runlog import EVENTS_FILE, RunLogger, active_logger, read_events
 _ACTIVE: list = []
 
 _UNSET = object()
+
+# cross-process trace context: "<trace_id>/<span_id>".  The supervisor
+# stamps it into each worker's env at spawn; a worker Tracer built via
+# from_env() adopts that trace for its root spans, so cluster.launch >
+# host.join > train.step is ONE trace spanning every process and
+# relaunch generation.
+TRACE_CONTEXT_ENV = "TDQ_TRACE_CONTEXT"
 
 
 def active_tracer() -> Optional["Tracer"]:
@@ -71,6 +79,29 @@ def attach_trace(exc: BaseException) -> BaseException:
     if tid is not None:
         exc.trace_id = tid
     return exc
+
+
+@contextlib.contextmanager
+def propagate_trace(span: Optional[Span] = None):
+    """Stamp the current trace context into ``TDQ_TRACE_CONTEXT`` for the
+    duration of the block (restoring the prior value after), so any
+    subprocess spawned inside — a retrain job, a relaunched worker —
+    inherits the trace via :meth:`Tracer.from_env`.  No-op without an
+    active tracer/span."""
+    tr = active_tracer()
+    ctx = tr.context(span) if tr is not None else None
+    if ctx is None:
+        yield None
+        return
+    prev = os.environ.get(TRACE_CONTEXT_ENV)
+    os.environ[TRACE_CONTEXT_ENV] = ctx
+    try:
+        yield ctx
+    finally:
+        if prev is None:
+            os.environ.pop(TRACE_CONTEXT_ENV, None)
+        else:
+            os.environ[TRACE_CONTEXT_ENV] = prev
 
 
 class Span:
@@ -116,6 +147,16 @@ class Tracer:
         logging into one run dir from colliding); tests pin it for
         deterministic ids (an explicit prefix is used verbatim, so two
         tracers given the SAME prefix collide — give each its own).
+      context: a ``"<trace_id>/<span_id>"`` string (the format
+        :meth:`context` produces and ``TDQ_TRACE_CONTEXT`` carries).
+        When set, every root span this tracer opens joins that trace
+        with the remote span as its parent — locally an orphan (the
+        parent lives in another process's run log), which
+        :func:`span_tree`'s salvage stance keeps as a root, and which a
+        stitched multi-run read grafts back under the real parent.
+        Inherited tracers also prefix their span ids with
+        ``<pid hex>.<instance>`` so ids from the N processes sharing one
+        trace never collide.
 
     Single-threaded by design, like the batcher event loop it
     instruments: the open-span stack is per-tracer and hosts that poll
@@ -127,7 +168,8 @@ class Tracer:
     def __init__(self, logger: Optional[RunLogger] = None, registry=None,
                  clock: Callable[[], float] = time.time,
                  perf: Callable[[], float] = time.perf_counter,
-                 trace_prefix: Optional[str] = None):
+                 trace_prefix: Optional[str] = None,
+                 context: Optional[str] = None):
         self._logger = logger
         self._registry = registry
         self._clock = clock
@@ -135,9 +177,61 @@ class Tracer:
         Tracer._n_instances += 1
         self._prefix = (trace_prefix if trace_prefix is not None
                         else f"tr{os.getpid():x}.{Tracer._n_instances:x}")
+        self._inherit_trace: Optional[str] = None
+        self._inherit_parent: Optional[str] = None
+        self._span_prefix = ""
+        if context:
+            trace, _, parent = str(context).partition("/")
+            self._inherit_trace = trace or None
+            self._inherit_parent = parent or None
+            # span ids must be unique across the processes sharing the
+            # inherited trace id — default-format ids (s0001, …) from two
+            # workers would collide in span_tree's (trace, span) keying
+            self._span_prefix = f"{os.getpid():x}.{Tracer._n_instances:x}-"
         self._n_traces = 0
         self._n_spans = 0
         self._stack: list = []
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None, **kw) -> "Tracer":
+        """Construct a Tracer inheriting the cross-process trace context
+        from ``TDQ_TRACE_CONTEXT`` (no-op — a plain Tracer — when the
+        variable is absent or empty).  The worker side of the contract
+        :class:`~tensordiffeq_tpu.resilience.ClusterSupervisor` stamps at
+        spawn."""
+        src = env if env is not None else os.environ
+        return cls(context=src.get(TRACE_CONTEXT_ENV) or None, **kw)
+
+    def context(self, span: Optional[Span] = None) -> Optional[str]:
+        """Serialize ``span`` (default: the current open span) as a
+        ``"<trace_id>/<span_id>"`` context string for
+        ``TDQ_TRACE_CONTEXT``.  With no span open, an inherited context
+        is passed through unchanged (a mid-chain worker re-stamps what
+        it received); returns None when there is nothing to propagate."""
+        sp = span if span is not None else self.current
+        if sp is not None:
+            return f"{sp.trace_id}/{sp.span_id}"
+        if self._inherit_trace is not None:
+            return (f"{self._inherit_trace}/{self._inherit_parent}"
+                    if self._inherit_parent else self._inherit_trace)
+        return None
+
+    def _root_ids(self, trace_id: Optional[str]):
+        """(trace_id, parent_id) for a new root span: the inherited
+        cross-process context when one exists, else a fresh
+        process-local trace."""
+        if trace_id is None:
+            if self._inherit_trace is not None:
+                return self._inherit_trace, self._inherit_parent
+            self._n_traces += 1
+            return f"{self._prefix}-{self._n_traces:04x}", None
+        if trace_id == self._inherit_trace:
+            return trace_id, self._inherit_parent
+        return trace_id, None
+
+    def _span_id(self) -> str:
+        self._n_spans += 1
+        return f"s{self._span_prefix}{self._n_spans:04x}"
 
     # ------------------------------------------------------------------ #
     def __enter__(self) -> "Tracer":
@@ -163,15 +257,13 @@ class Tracer:
         trace); pass ``parent=None`` to force a new root."""
         if parent is _UNSET:
             parent = self.current
-        if trace_id is None:
-            if parent is not None:
+        if parent is not None:
+            parent_id = parent.span_id
+            if trace_id is None:
                 trace_id = parent.trace_id
-            else:
-                self._n_traces += 1
-                trace_id = f"{self._prefix}-{self._n_traces:04x}"
-        self._n_spans += 1
-        sp = Span(trace_id, f"s{self._n_spans:04x}",
-                  parent.span_id if parent is not None else None,
+        else:
+            trace_id, parent_id = self._root_ids(trace_id)
+        sp = Span(trace_id, self._span_id(), parent_id,
                   name, self._clock(), self._perf(), attrs)
         self._stack.append(sp)
         return sp
@@ -214,16 +306,16 @@ class Tracer:
         way."""
         if parent is _UNSET:
             parent = self.current
+        parent_id = parent.span_id if isinstance(parent, Span) else parent
         if trace_id is None:
-            if parent is not None:
+            if isinstance(parent, Span):
                 trace_id = parent.trace_id
-            else:
-                self._n_traces += 1
-                trace_id = f"{self._prefix}-{self._n_traces:04x}"
-        self._n_spans += 1
+            elif parent is None:
+                trace_id, parent_id = self._root_ids(None)
+            else:  # bare span-id parent: join the inherited/fresh trace
+                trace_id, _ = self._root_ids(None)
         duration_s = max(float(duration_s), 0.0)
-        sp = Span(trace_id, f"s{self._n_spans:04x}",
-                  parent.span_id if isinstance(parent, Span) else parent,
+        sp = Span(trace_id, self._span_id(), parent_id,
                   name,
                   (float(t_start) if t_start is not None
                    else self._clock() - duration_s), 0.0, attrs)
@@ -327,44 +419,77 @@ def _depth(span: dict, by_id: dict, limit: int = 64) -> int:
     return d
 
 
-def to_perfetto(run_dir: str, path: Optional[str] = None) -> dict:
-    """Convert a run's ``trace`` events to Chrome trace-event JSON
-    (the ``traceEvents`` array format Perfetto and ``chrome://tracing``
+def _span_event(s: dict, pid: int, by_id: dict) -> dict:
+    args = dict(s.get("attrs") or {})
+    args["trace_id"] = s.get("trace")
+    args["span_id"] = s.get("span")
+    if s.get("error"):
+        args["error"] = s["error"]
+    ev = {
+        "name": s.get("name", "?"),
+        "cat": str(s.get("name", "?")).split(".")[0],
+        "ph": "X",
+        "ts": round(float(s.get("start", 0.0)) * 1e6, 3),
+        "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
+        "pid": pid,
+        "tid": _depth(s, by_id),
+        "args": args,
+    }
+    if s.get("status") == "error":
+        ev["cname"] = "terrible"  # red in chrome://tracing
+    return ev
+
+
+def to_perfetto(run_dir, path: Optional[str] = None) -> dict:
+    """Convert ``trace`` events to Chrome trace-event JSON (the
+    ``traceEvents`` array format Perfetto and ``chrome://tracing``
     load).  Each span becomes a complete (``"ph": "X"``) event: ``ts`` /
-    ``dur`` in microseconds, one ``pid`` per trace, ``tid`` = span depth
-    (children nest visually under their parents).  Writes ``path`` when
-    given (default ``<run_dir>/trace.perfetto.json``) and returns the
-    dict either way."""
-    spans = read_spans(run_dir)
-    by_id = {(s.get("trace"), s.get("span")): s for s in spans}
-    pids: dict = {}
-    events = []
-    for s in spans:
-        tid_key = s.get("trace")
-        pid = pids.setdefault(tid_key, len(pids) + 1)
-        args = dict(s.get("attrs") or {})
-        args["trace_id"] = s.get("trace")
-        args["span_id"] = s.get("span")
-        if s.get("error"):
-            args["error"] = s["error"]
-        events.append({
-            "name": s.get("name", "?"),
-            "cat": str(s.get("name", "?")).split(".")[0],
-            "ph": "X",
-            "ts": round(float(s.get("start", 0.0)) * 1e6, 3),
-            "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
-            "pid": pid,
-            "tid": _depth(s, by_id),
-            "args": args,
-        })
-        if s.get("status") == "error":
-            events[-1]["cname"] = "terrible"  # red in chrome://tracing
-    out = {"traceEvents": events, "displayTimeUnit": "ms",
-           "otherData": {"source": "tensordiffeq_tpu.telemetry.tracing",
-                         "run_dir": str(run_dir),
-                         "events_file": EVENTS_FILE}}
-    target = path if path is not None else os.path.join(
-        str(run_dir), "trace.perfetto.json")
+    ``dur`` in microseconds, ``tid`` = span depth (children nest
+    visually under their parents).
+
+    Single run dir: one ``pid`` per trace, written to
+    ``<run_dir>/trace.perfetto.json`` (or ``path``).
+
+    **Stitch mode** — ``run_dir`` a list/tuple of run dirs: one ``pid``
+    per *process* (run dir), named via ``process_name`` metadata, and
+    span depth computed over the union of all runs' spans, so a worker
+    root whose parent lives in the supervisor's log nests under it and a
+    host-loss incident (supervisor + N workers × relaunch generations
+    sharing one propagated trace id) renders as a single timeline.
+    Default output: ``trace.stitched.perfetto.json`` in the first dir.
+    """
+    if isinstance(run_dir, (list, tuple)):
+        dirs = [str(d) for d in run_dir]
+        per_dir = [read_spans(d) for d in dirs]
+        by_id = {(s.get("trace"), s.get("span")): s
+                 for spans in per_dir for s in spans}
+        events = []
+        for pid, (d, spans) in enumerate(zip(dirs, per_dir), start=1):
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": os.path.basename(
+                               os.path.normpath(d)) or d}})
+            events.extend(_span_event(s, pid, by_id) for s in spans)
+        out = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"source": "tensordiffeq_tpu.telemetry.tracing",
+                             "run_dirs": dirs, "stitched": True,
+                             "events_file": EVENTS_FILE}}
+        target = path if path is not None else (
+            os.path.join(dirs[0], "trace.stitched.perfetto.json")
+            if dirs else None)
+    else:
+        spans = read_spans(run_dir)
+        by_id = {(s.get("trace"), s.get("span")): s for s in spans}
+        pids: dict = {}
+        events = [
+            _span_event(s, pids.setdefault(s.get("trace"), len(pids) + 1),
+                        by_id)
+            for s in spans]
+        out = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"source": "tensordiffeq_tpu.telemetry.tracing",
+                             "run_dir": str(run_dir),
+                             "events_file": EVENTS_FILE}}
+        target = path if path is not None else os.path.join(
+            str(run_dir), "trace.perfetto.json")
     if target:
         with open(target, "w") as fh:
             json.dump(out, fh)

@@ -456,9 +456,9 @@ def test_pallas_coverage_passes_with_interpret_test(tmp_path):
 # --------------------------------------------------------------------- #
 
 def test_rule_registry_shape():
-    assert len(rules.ALL_RULES) == 8
+    assert len(rules.ALL_RULES) == 9
     ids = [r.id for r in rules.ALL_RULES]
-    assert len(set(ids)) == 8
+    assert len(set(ids)) == 9
     assert all(r.doc for r in rules.ALL_RULES)
     assert set(rules.RULES_BY_ID) == set(ids)
 

@@ -24,8 +24,8 @@ def resample_bench_proc():
     subprocess contract tests (minimax / serving / fleet / elastic —
     whose supervisors spend much of their wall in probe timeouts and
     idle waits) instead of serializing after them.
-    ``test_resample_json_contract_on_cpu_fallback`` is deliberately the
-    second-to-LAST test in the file (the closedloop join is last) — it
+    ``test_resample_json_contract_on_cpu_fallback`` is deliberately
+    third-to-last in the file (the closedloop and obs joins follow) — it
     joins the process there (tier-1 wall discipline: the suite brushes
     its 870 s gate on this host, so new subprocess work must hide behind
     existing waits, not add to them)."""
@@ -49,8 +49,8 @@ def closedloop_bench_proc():
     """Start the --closedloop contract subprocess at module setup with
     the other two (same wall discipline: the drift -> retrain -> swap
     cycle cooks behind this module's in-process tests).  Joined by
-    ``test_closedloop_json_contract_on_cpu_fallback``, the LAST test in
-    the file — the resample join moves up to second-to-last."""
+    ``test_closedloop_json_contract_on_cpu_fallback``, second-to-last in
+    the file (the obs join is last)."""
     cache_dir = tempfile.mkdtemp(prefix="bench_closedloop_cache_")
     env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="560",
                JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
@@ -72,8 +72,8 @@ def factory_bench_proc():
     one at module setup (same wall discipline: the family-vs-sequential
     race cooks behind this module's in-process tests and the resample
     race's idle probe waits).  Joined by
-    ``test_factory_json_contract_on_cpu_fallback``, third-to-last in
-    the file — then the resample join, then the closedloop join last."""
+    ``test_factory_json_contract_on_cpu_fallback``, fourth-to-last in
+    the file — then the resample, closedloop, and obs joins."""
     cache_dir = tempfile.mkdtemp(prefix="bench_factory_cache_")
     env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="420",
                JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
@@ -81,6 +81,32 @@ def factory_bench_proc():
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py"), "--mode",
          "factory"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    yield proc
+    if proc.poll() is None:  # join test skipped/failed early: reap it
+        proc.kill()
+        proc.communicate()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def obs_bench_proc():
+    """Start the --obs contract subprocess at module setup with the
+    other three (same wall discipline: the bare-vs-observed traffic race
+    cooks behind this module's in-process tests).  Joined by
+    ``test_obs_json_contract_on_cpu_fallback``, the LAST test in the
+    file — the closedloop join moves up to second-to-last."""
+    cache_dir = tempfile.mkdtemp(prefix="bench_obs_cache_")
+    # 545 not 420: four bench subprocesses cook concurrently on the CI
+    # host and the obs worker is compile-bound before its timed phases —
+    # at 420 a loaded run got budget-killed after the bare phase and the
+    # salvaged partial (vs_baseline None) failed the contract.  The join
+    # below still bounds the wait at communicate(timeout=580).
+    env = dict(os.environ, BENCH_FAST="1", BENCH_BUDGET="545",
+               JAX_PLATFORMS="cpu", TDQ_PLATFORM="cpu",
+               PALLAS_AXON_POOL_IPS="", BENCH_TPU_CACHE_DIR=cache_dir)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "obs"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         cwd=REPO, env=env)
     yield proc
@@ -787,9 +813,9 @@ def test_factory_json_contract_on_cpu_fallback(factory_bench_proc):
     means a distinct program, the exact cost the one-program family
     deletes; measured 6.5x on this host).  The idealized shared-scan
     arm (sequential granted the one-program property) is disclosed
-    alongside.  KEEP THIRD-TO-LAST (before the resample and closedloop
-    joins): the subprocess was started by the module fixture, so joining
-    here pays only the residual wall."""
+    alongside.  KEEP FOURTH-TO-LAST (before the resample, closedloop,
+    and obs joins): the subprocess was started by the module fixture, so
+    joining here pays only the residual wall."""
     out, err = factory_bench_proc.communicate(timeout=580)
     assert factory_bench_proc.returncode == 0, err[-2000:]
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
@@ -823,10 +849,10 @@ def test_resample_json_contract_on_cpu_fallback(resample_bench_proc):
     the >=3x bar leaves throttle headroom), and (3) the PACMANN ascent
     arm reaches the gate in fewer steps than the pool->top-k arm at the
     same cadence (measured 2300 vs 3300) with the same pipelined ms-band
-    stall.  KEEP SECOND-TO-LAST (only the
-    closedloop join follows): the subprocess was started by the module
-    fixture before the other contract tests ran, so joining here pays
-    only the residual wall, not the full race."""
+    stall.  KEEP THIRD-TO-LAST (the
+    closedloop and obs joins follow): the subprocess was started by the
+    module fixture before the other contract tests ran, so joining here
+    pays only the residual wall, not the full race."""
     out, err = resample_bench_proc.communicate(timeout=580)
     assert resample_bench_proc.returncode == 0, err[-2000:]
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
@@ -876,9 +902,9 @@ def test_closedloop_json_contract_on_cpu_fallback(closedloop_bench_proc):
     completes, every tenant hot-swaps behind its canary gate with zero
     request-time compiles, the cutover stall stays sub-second, and the
     post-swap probe residual improves on the drifted one (the loop
-    healed the fleet; measured 4x on this host).  KEEP THIS TEST LAST IN
-    THE FILE: the subprocess was started by the module fixture, so
-    joining here pays only the residual wall."""
+    healed the fleet; measured 4x on this host).  KEEP SECOND-TO-LAST
+    (only the obs join follows): the subprocess was started by the
+    module fixture, so joining here pays only the residual wall."""
     out, err = closedloop_bench_proc.communicate(timeout=580)
     assert closedloop_bench_proc.returncode == 0, err[-2000:]
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
@@ -898,4 +924,66 @@ def test_closedloop_json_contract_on_cpu_fallback(closedloop_bench_proc):
     res = p["residual"]
     assert res["drifted"] > res["baseline"]  # the injection was real
     assert res["improvement"] > 1.0  # ... and the loop healed it
+    assert p["backend"] == "cpu"  # this env: the fallback really ran
+
+
+def test_obs_mode_registered():
+    """--obs is a first-class mode: distinct cache artifact and the
+    --mode spelling maps onto it (budget entry pinned by the subprocess
+    contract test running inside its BENCH_BUDGET)."""
+    bench = _load_bench()
+    assert bench.mode_name(["--obs"]) == "obs"
+    assert bench.tpu_cache_file(["--obs"]).endswith("BENCH_TPU_obs.json")
+
+
+def test_obs_partial_carries_real_headline():
+    """The bare-phase partial streamed by --obs must publish the bare
+    QPS as a real headline with the incompleteness disclosed — and a
+    payload with no bare measurement yields no partial at all."""
+    bench = _load_bench()
+    assert bench.obs_partial({"bare": {"qps": None}}) is None
+    p = bench.obs_partial(
+        {"metric": "fleet serving QPS under the full observability "
+                   "plane (2 tenants; ...)",
+         "value": None, "unit": "queries/sec/chip",
+         "bare": {"qps": 777, "wall_s": [0.3, 0.31]},
+         "noise_band": 0.03})
+    assert p["value"] == 777
+    assert "incomplete" in p["metric"] and "note" in p
+
+
+def test_obs_json_contract_on_cpu_fallback(obs_bench_proc):
+    """`python bench.py --mode obs` must emit ONE valid JSON line
+    pricing the PR-19 observability plane — and the contract IS the
+    acceptance bar: the same multi-tenant traffic runs bare (twice, the
+    spread disclosed as the noise band) and then fully observed (span
+    tracer into a rotating run log, flight-recorder ring, collector
+    serving /metrics + /healthz and scraped DURING traffic), both
+    phases complete, with the scrape latency, flight-flush wall,
+    fleet-wide health verdict, and trace tallies all disclosed.  KEEP
+    THIS TEST LAST IN THE FILE: the subprocess was started by the
+    module fixture, so joining here pays only the residual wall."""
+    out, err = obs_bench_proc.communicate(timeout=580)
+    assert obs_bench_proc.returncode == 0, err[-2000:]
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out  # supervisor: exactly one line
+    p = json.loads(lines[0])
+    assert p["unit"] == "queries/sec/chip"
+    assert isinstance(p["value"], (int, float)) and p["value"] > 0
+    # the bare baseline ran twice and its jitter is disclosed — an
+    # overhead number without its noise floor would overclaim precision
+    assert p["bare"]["qps"] > 0 and len(p["bare"]["wall_s"]) == 2
+    assert p["noise_band"] is not None and p["noise_band"] >= 0
+    assert p["vs_baseline"] is not None and p["vs_baseline"] > 0
+    assert p["overhead_fraction"] is not None
+    # the collector was scraped while traffic flowed, and answered
+    assert p["scrapes"]["n"] >= 1 and p["scrapes"]["max_ms"] > 0
+    assert "ok" in p["healthz"]
+    assert p["healthz"]["exit_status"] in (0, 3)
+    # the flight ring flushed to disk and the tracer really recorded
+    assert p["flight"]["records"] > 0 and p["flight"]["flush_ms"] >= 0
+    assert p["trace"]["events"] > 0 and p["trace"]["segments"] >= 1
+    # the observed run's instruments land in the payload telemetry block
+    counters = p["telemetry"]["metrics"]["counters"]
+    assert counters.get("flight.flushes{reason=bench}") == 1
     assert p["backend"] == "cpu"  # this env: the fallback really ran

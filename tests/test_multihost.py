@@ -25,6 +25,7 @@ re-shards the 8-device checkpoint onto its 4 local devices and finishes
 the job.
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -70,18 +71,32 @@ WORKER = textwrap.dedent("""
     elif mode == "elastic":
         # the supervisor's worker contract: resume against TOTAL budgets,
         # flush + exit 75 on preemption (= the supervisor's drain SIGTERM)
-        ckpt = sys.argv[5]
+        # — wired through the full observability plane: a per-generation
+        # run log, the inherited cross-process trace context, and a
+        # flight recorder whose ring the chaos host-loss path flushes
+        # before its os._exit
+        ckpt, runroot = sys.argv[5], sys.argv[6]
+        gen = os.environ.get("TDQ_CLUSTER_GENERATION", "0")
+        from tensordiffeq_tpu import telemetry
         from tensordiffeq_tpu.resilience import (Preempted,
                                                  PreemptionHandler,
                                                  auto_resume,
                                                  handle_preemption)
         solver = build_solver(dist=True)
-        with PreemptionHandler(deadline_s=30):
-            try:
-                auto_resume(solver, ckpt, tf_iter=20, checkpoint_every=5,
-                            chunk=5)
-            except Preempted as e:
-                handle_preemption(e)  # exits RESUMABLE_EXIT_CODE (75)
+        run_dir = os.path.join(runroot, f"gen{gen}.w{pid}")
+        with telemetry.RunLogger(run_dir,
+                                 config={"gen": gen, "pid": pid}) as run, \\
+                telemetry.Tracer.from_env(logger=run), \\
+                telemetry.FlightRecorder(run_dir, capacity=128):
+            # grad_norm=False keeps the compiled step bit-identical to
+            # the uninterrupted reference the test compares against
+            tele = telemetry.TrainingTelemetry(logger=run, grad_norm=False)
+            with PreemptionHandler(deadline_s=30):
+                try:
+                    auto_resume(solver, ckpt, tf_iter=20,
+                                checkpoint_every=5, chunk=5, telemetry=tele)
+                except Preempted as e:
+                    handle_preemption(e)  # exits RESUMABLE_EXIT_CODE (75)
     else:
         solver = build_solver(dist=True)
         solver.fit(tf_iter=20, newton_iter=5)
@@ -278,17 +293,35 @@ def test_elastic_host_loss_supervisor_relaunch(worker_dir, eight_devices,
     its 4 local devices and finishes the 20-epoch budget.  The final
     trajectory must match an uninterrupted single-process run — the
     re-shard at restore is exact, so tolerance is fp-reduction-order
-    only."""
+    only.
+
+    The SAME cluster run is the observability-plane acceptance (PR 19):
+    the propagated trace context must stitch supervisor + both workers +
+    the relaunch generation into ONE Perfetto trace, the collector
+    mounted on the supervisor must serve the fleet's merged metrics
+    under host/process labels over ``/metrics``, and the chaos-killed
+    worker must leave a ``flight.jsonl`` whose final span is the
+    training chunk it died in."""
+    import urllib.request
+
     from tensordiffeq_tpu.resilience import ClusterSupervisor
-    from tensordiffeq_tpu.telemetry import RunLogger, read_events
+    from tensordiffeq_tpu.telemetry import (MetricsRegistry, RunLogger,
+                                            flight_sections, read_events,
+                                            tracing)
     from tensordiffeq_tpu.telemetry.tracing import Tracer
+
+    from test_slo import parse_exposition
 
     ckpt = tmp_path / "elastic_ck"
     run_dir = tmp_path / "elastic_run"
+    wruns = tmp_path / "wruns"
+    wdirs = [str(wruns / "gen0.w0"), str(wruns / "gen0.w1"),
+             str(wruns / "gen1.w0")]
 
     def worker_cmd(pid, nproc, port):
         return [sys.executable, str(worker_dir / "worker.py"),
-                str(pid), str(nproc), str(port), "elastic", str(ckpt)]
+                str(pid), str(nproc), str(port), "elastic", str(ckpt),
+                str(wruns)]
 
     logger = RunLogger(str(run_dir), config={"test": "elastic"})
     with logger, Tracer(logger=logger) as tracer:
@@ -296,18 +329,27 @@ def test_elastic_host_loss_supervisor_relaunch(worker_dir, eight_devices,
             worker_cmd, nproc=2, workdir=str(tmp_path / "sup"),
             heartbeat_timeout_s=180,  # compile + host contention ride
             grace_s=5.0,              # survivor is wedged; don't linger
-            max_relaunches=2, tracer=tracer,
+            max_relaunches=2, tracer=tracer, registry=MetricsRegistry(),
             env=dict(_cluster_env(), TDQ_CHAOS="host_loss_at=10"))
-        # overlap: the uninterrupted reference trajectory computes in
-        # THIS process while the cluster runs in its own (the supervisor
-        # thread only polls files/processes — no GIL contention with the
-        # fit's XLA execution)
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(1) as ex:
-            fut = ex.submit(sup.run, 400)
-            sp = _single_process_losses(worker_dir, tf_iter=20,
-                                        newton_iter=0, chunk=5)
-            result = fut.result()
+        # the collector mounts BEFORE launch and tails the worker run
+        # dirs as they appear (a dir that doesn't exist yet is an empty
+        # tail, not an error)
+        coll = sup.serve_metrics(host="mh-host", run_dirs=wdirs)
+        try:
+            # overlap: the uninterrupted reference trajectory computes in
+            # THIS process while the cluster runs in its own (the
+            # supervisor thread only polls files/processes — no GIL
+            # contention with the fit's XLA execution)
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(1) as ex:
+                fut = ex.submit(sup.run, 400)
+                sp = _single_process_losses(worker_dir, tf_iter=20,
+                                            newton_iter=0, chunk=5)
+                result = fut.result()
+            metrics_body = urllib.request.urlopen(
+                f"{coll.url}/metrics", timeout=10).read().decode()
+        finally:
+            coll.close()
 
     assert result.ok, result
     assert result.hosts_lost == 1 and result.relaunches == 1, result
@@ -341,6 +383,71 @@ def test_elastic_host_loss_supervisor_relaunch(worker_dir, eight_devices,
     assert lost["attrs"]["pid"] == 1 and lost["status"] == "error"
     reshard = next(s for s in spans if s["name"] == "reshard.restore")
     assert reshard["status"] == "ok"
+
+    # ---- observability plane: one stitched trace across the fleet ----
+    # every worker generation inherited TDQ_TRACE_CONTEXT from the
+    # supervisor, so all train.step roots grafted onto the job trace
+    job_trace = spans[0]["trace"]
+    assert all(s["trace"] == job_trace for s in spans)
+    all_dirs = [str(run_dir)] + wdirs
+    tracing.to_perfetto(all_dirs)
+    stitched_path = run_dir / "trace.stitched.perfetto.json"
+    assert stitched_path.exists()
+    with open(stitched_path) as fh:
+        stitched = json.load(fh)
+    assert stitched["otherData"]["stitched"] is True
+    metas = sorted((ev["pid"], ev["args"]["name"])
+                   for ev in stitched["traceEvents"] if ev["ph"] == "M")
+    assert metas == [(1, "elastic_run"), (2, "gen0.w0"),
+                     (3, "gen0.w1"), (4, "gen1.w0")]
+    slices = [ev for ev in stitched["traceEvents"] if ev["ph"] == "X"]
+    assert {ev["args"]["trace_id"] for ev in slices} == {job_trace}
+    assert {ev["pid"] for ev in slices} == {1, 2, 3, 4}
+    # the union tree has exactly the two launch spans as roots: every
+    # worker span — both generations — hangs off the single job trace
+    union = []
+    for d in all_dirs:
+        union += [e for e in read_events(d) if e.get("kind") == "trace"]
+    forest = tracing.span_tree(union)
+    assert set(forest) == {job_trace}
+    assert sorted(r["name"] for r in forest[job_trace]) \
+        == ["cluster.launch", "cluster.launch"]
+
+    # ---- /metrics round-trips through the exposition parser with
+    # host/process labels merged across supervisor + worker run logs ----
+    samples, types = parse_exposition(metrics_body)
+
+    def sample(name, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        assert key in samples, (name, labels, sorted(samples))
+        return samples[key]
+
+    sup_proc = f"supervisor:{os.getpid()}"
+    assert sample("cluster_launches_total",
+                  host="mh-host", process=sup_proc) == 2
+    assert sample("cluster_relaunches_total",
+                  host="mh-host", process=sup_proc) == 1
+    assert sample("cluster_host_lost_total", host="mh-host",
+                  process=sup_proc, reason="exit") == 1
+    assert types["cluster_hosts"] == "gauge"
+    assert sample("cluster_hosts", host="mh-host", process=sup_proc) == 1
+    # the tailed worker run logs surfaced as per-process event counts
+    assert sample("collector_events_total",
+                  host="mh-host", process="gen0.w1") > 0
+
+    # ---- the killed worker's flight recorder: the ring's final span is
+    # the chunk it died in, flushed by the chaos host-loss path ----
+    sections = flight_sections(str(wruns / "gen0.w1"))
+    assert sections, "chaos-killed worker left no flight.jsonl"
+    header, records = sections[-1]["header"], sections[-1]["records"]
+    assert header["reason"] == "host_loss"
+    ring_spans = [r for r in records if r.get("kind") == "trace"]
+    assert ring_spans and ring_spans[-1]["name"] == "train.step"
+    assert ring_spans[-1]["trace"] == job_trace
+    chaos_ev = next(r for r in records
+                    if r.get("kind") == "chaos" and "fault" in r)
+    assert chaos_ev["fault"] == "host_loss" and chaos_ev["epoch"] == 10
+    assert records.index(chaos_ev) > records.index(ring_spans[-1])
 
 
 def test_cluster_heartbeat_chaos_off_bit_identity(eight_devices, tmp_path,

@@ -1,0 +1,496 @@
+"""The fleet observability plane (PR 19): the ISSUE-pinned contracts.
+
+* run-log size rotation: sealed segments are rename-stable, numeric
+  suffix order, reads span segments transparently;
+* cross-process trace context: ``Tracer.context()`` ⇄
+  ``Tracer.from_env()`` round-trip, ``propagate_trace`` env hygiene,
+  and ``to_perfetto`` stitch mode (one pid per run dir, cross-process
+  graft over the union);
+* the flight recorder: bounded ring, append-only flush sections, the
+  exception / atexit / disarm paths, and ``flush_flight`` as a no-op
+  without a recorder;
+* the collector: rotation-resumable tailing, torn-vs-pending line
+  accounting, host/process re-labeling that round-trips through the
+  Prometheus exposition (``test_slo.parse_exposition``), ``/metrics`` /
+  ``/healthz`` over HTTP;
+* trace-id continuity through the resilience paths: ``retry_call``
+  attempts, ``ResilientFit`` rollback/retry, and ``auto_resume`` after
+  a preemption with a real env round-trip.
+
+The resilience tests drive a duck-typed stub solver (real checkpoints,
+real supervisors, no PDE): the property under test is the telemetry
+plumbing, and the stub keeps the whole file jit-free — tier-1 fast.
+The full-stack story (supervised cluster + chaos + stitching + flight)
+is tier-2, in ``tests/test_multihost.py``.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import checkpoint
+from tensordiffeq_tpu.resilience import (Preempted, ResilientFit,
+                                         RetryPolicy, auto_resume,
+                                         clear_preemption,
+                                         handle_preemption, retry_call)
+from tensordiffeq_tpu.resilience.preemption import RESUMABLE_EXIT_CODE
+from tensordiffeq_tpu.telemetry import (FLIGHT_FILE, TRACE_CONTEXT_ENV,
+                                        Collector, FlightRecorder,
+                                        MetricsRegistry, RunLogger, SLOSet,
+                                        Tracer, TrainingDiverged,
+                                        active_flight_recorder,
+                                        event_segments, flight_sections,
+                                        flush_flight, read_events, tracing)
+from tensordiffeq_tpu.telemetry.runlog import EVENTS_FILE, read_manifest
+from tensordiffeq_tpu.telemetry.tracing import propagate_trace
+
+from test_slo import parse_exposition
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption_flag():
+    clear_preemption()
+    yield
+    clear_preemption()
+
+
+# --------------------------------------------------------------------------- #
+# run-log rotation
+# --------------------------------------------------------------------------- #
+def test_runlog_rotation_segments_and_readback(tmp_path):
+    """Rotation seals numeric segments (.1 oldest), never renames a
+    sealed one again, and read_events reads across all of them in
+    append order — including past .9 → .10 (numeric, not lexicographic,
+    ordering)."""
+    d = str(tmp_path / "run")
+    with RunLogger(d, run_id="r", registry=MetricsRegistry(),
+                   rotate_bytes=256) as run:
+        for i in range(120):
+            run.event("beat", i=i)
+        n_rot = run.n_rotations
+    assert n_rot > 10  # enough segments to exercise numeric suffix sort
+    segs = event_segments(d)
+    assert len(segs) == n_rot + 1  # sealed segments + the live file
+    assert segs[-1].endswith(EVENTS_FILE)
+    suffixes = [int(p.rsplit(".", 1)[-1]) for p in segs[:-1]]
+    assert suffixes == list(range(1, n_rot + 1))
+    beats = read_events(d, kind="beat")
+    assert [r["i"] for r in beats] == list(range(120))
+    assert read_manifest(d)["n_rotations"] == n_rot
+
+
+# --------------------------------------------------------------------------- #
+# cross-process trace context
+# --------------------------------------------------------------------------- #
+def test_trace_context_round_trip_and_from_env():
+    with Tracer(trace_prefix="t") as tr:
+        with tr.span("cluster.launch") as sp:
+            ctx = tr.context()
+            assert ctx == f"{sp.trace_id}/{sp.span_id}"
+        assert tr.context() is None  # nothing open, nothing inherited
+
+    child = Tracer.from_env({TRACE_CONTEXT_ENV: ctx})
+    csp = child.open_span("host.join")
+    # the root joins the parent's trace, with the remote span as parent
+    assert csp.trace_id == sp.trace_id
+    assert csp.parent_id == sp.span_id
+    # span ids are pid-prefixed so N inheriting workers never collide
+    assert csp.span_id.startswith(f"s{os.getpid():x}.")
+    child.close_span(csp)
+    # mid-chain re-stamp: with no span open the inherited context passes
+    # through unchanged
+    assert child.context() == ctx
+
+    plain = Tracer.from_env({})  # absent context: a plain local tracer
+    psp = plain.open_span("root")
+    assert psp.parent_id is None and psp.trace_id != sp.trace_id
+    plain.close_span(psp)
+
+
+def test_propagate_trace_stamps_and_restores_env(monkeypatch):
+    monkeypatch.delenv(TRACE_CONTEXT_ENV, raising=False)
+    with propagate_trace():  # no active tracer: a no-op
+        assert TRACE_CONTEXT_ENV not in os.environ
+    with Tracer(trace_prefix="t") as tr, tr.span("root") as sp:
+        with propagate_trace() as ctx:
+            assert ctx == f"{sp.trace_id}/{sp.span_id}"
+            assert os.environ[TRACE_CONTEXT_ENV] == ctx
+        assert TRACE_CONTEXT_ENV not in os.environ  # restored (was unset)
+        monkeypatch.setenv(TRACE_CONTEXT_ENV, "prior/ctx")
+        with propagate_trace():
+            assert os.environ[TRACE_CONTEXT_ENV] != "prior/ctx"
+        assert os.environ[TRACE_CONTEXT_ENV] == "prior/ctx"  # restored
+
+
+def test_to_perfetto_stitch_mode_grafts_across_run_dirs(tmp_path):
+    sup, w0 = str(tmp_path / "sup"), str(tmp_path / "w0")
+    with RunLogger(sup, run_id="s", registry=MetricsRegistry()), \
+            Tracer(trace_prefix="job") as tr:
+        with tr.span("cluster.launch") as launch:
+            ctx = tr.context()
+    with RunLogger(w0, run_id="w", registry=MetricsRegistry()) as runw, \
+            Tracer(context=ctx, logger=runw) as trw:
+        with trw.span("host.join"):
+            with trw.span("train.step"):
+                pass
+
+    out = tracing.to_perfetto([sup, w0])
+    assert os.path.exists(os.path.join(sup, "trace.stitched.perfetto.json"))
+    assert out["otherData"]["stitched"] is True
+    meta = [e for e in out["traceEvents"] if e.get("ph") == "M"]
+    assert [(m["pid"], m["args"]["name"]) for m in meta] == \
+        [(1, "sup"), (2, "w0")]
+    slices = {e["name"]: e for e in out["traceEvents"] if e.get("ph") == "X"}
+    assert slices["cluster.launch"]["pid"] == 1
+    assert slices["host.join"]["pid"] == 2
+    # depth over the UNION: the worker root nests under the supervisor
+    # span even though its parent lives in another process's log
+    assert slices["cluster.launch"]["tid"] == 0
+    assert slices["host.join"]["tid"] == 1
+    assert slices["train.step"]["tid"] == 2
+
+    spans = tracing.read_spans(sup) + tracing.read_spans(w0)
+    assert {s["trace"] for s in spans} == {launch.trace_id}
+    roots = tracing.span_tree(spans)[launch.trace_id]
+    assert [r["name"] for r in roots] == ["cluster.launch"]
+    assert [c["name"] for c in roots[0]["children"]] == ["host.join"]
+    # a single-dir read keeps the same span as an orphan ROOT (salvage)
+    solo = tracing.span_tree(tracing.read_spans(w0))
+    assert [r["name"] for r in solo[launch.trace_id]] == ["host.join"]
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------------- #
+def test_flight_ring_capacity_and_sections(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    with RunLogger(d, run_id="r", registry=reg) as run, \
+            FlightRecorder(d, capacity=4, registry=reg) as fr:
+        for i in range(10):
+            run.event("beat", i=i)
+        assert fr.n_seen == 10  # the tap saw everything...
+        path = flush_flight("first")  # ...the ring kept the last 4
+        assert path == os.path.join(d, FLIGHT_FILE)
+        run.event("beat", i=10)
+        fr.flush("second")
+    secs = flight_sections(d)
+    assert [s["header"]["reason"] for s in secs] == ["first", "second"]
+    assert [r["i"] for r in secs[0]["records"]] == [6, 7, 8, 9]
+    hdr = secs[0]["header"]
+    assert hdr["n_records"] == 4 and hdr["pid"] == os.getpid()
+    counters = reg.as_dict()["counters"]
+    assert counters["flight.flushes{reason=first}"] == 1
+    assert counters["flight.flushes{reason=second}"] == 1
+
+
+def test_flush_flight_is_noop_without_recorder():
+    assert active_flight_recorder() is None
+    assert flush_flight("whatever") is None
+
+
+def test_flight_flushes_on_exception_exit(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with RunLogger(d, run_id="r", registry=reg) as run, \
+                FlightRecorder(d, registry=reg):
+            run.event("beat", i=0)
+            raise RuntimeError("boom")
+    secs = flight_sections(d)
+    assert secs[-1]["header"]["reason"] == "exception"
+    assert secs[-1]["header"]["error"] == "RuntimeError: boom"
+    assert secs[-1]["records"][-1]["kind"] == "beat"
+
+
+def test_flight_atexit_backstop_flushes_once_and_disarms(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    fr = FlightRecorder(d, registry=reg)
+    with RunLogger(d, run_id="r", registry=reg) as run, fr:
+        run.event("beat", i=0)
+    fr._atexit_flush()
+    assert flight_sections(d)[-1]["header"]["reason"] == "atexit"
+    fr._atexit_flush()  # already flushed: the backstop is a no-op now
+    assert len(flight_sections(d)) == 1
+
+    d2 = str(tmp_path / "clean")
+    fr2 = FlightRecorder(d2, registry=reg)
+    with RunLogger(d2, run_id="r2", registry=reg) as run2, fr2:
+        run2.event("beat", i=0)
+    fr2.disarm()  # a cleanly-finished run leaves no flight file
+    fr2._atexit_flush()
+    assert not os.path.exists(os.path.join(d2, FLIGHT_FILE))
+
+
+# --------------------------------------------------------------------------- #
+# collector
+# --------------------------------------------------------------------------- #
+def test_collector_tail_survives_rotation(tmp_path):
+    """The (sealed-segments, offset) tail state: a rotation between
+    polls loses nothing and re-reads nothing."""
+    d = str(tmp_path / "w0")
+    coll = Collector(registry=MetricsRegistry())
+    with RunLogger(d, run_id="r", registry=MetricsRegistry(),
+                   rotate_bytes=256) as run:
+        coll.watch(d, host="h0")
+        for i in range(10):
+            run.event("beat", i=i)
+        coll.poll()  # mid-write poll: partially consumes the live file
+        for i in range(10, 40):
+            run.event("beat", i=i)  # forces rotations under the tail
+        assert run.n_rotations >= 2
+        coll.poll()
+    coll.poll()
+    beats = [r for r in coll.events if r.get("kind") == "beat"]
+    assert [r["i"] for r in beats] == list(range(40))
+
+
+def test_collector_counts_torn_lines_and_leaves_partials_pending(tmp_path):
+    d = str(tmp_path / "w0")
+    os.makedirs(d)
+    path = os.path.join(d, EVENTS_FILE)
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"v": 2, "t": 0, "kind": "beat", "i": 0}) + "\n")
+        fh.write("{not json}\n")  # complete but undecodable: torn
+        fh.write('{"v": 2, "t": 0, "kind": "beat", "i": 1')  # mid-write
+    coll = Collector(registry=MetricsRegistry())
+    coll.watch(d, host="h", process="w0")
+    assert coll.poll() == 1
+    counters = coll.registry.as_dict()["counters"]
+    assert counters["collector.torn_lines{host=h,process=w0}"] == 1
+    # the half-written tail is PENDING, not torn: finishing the line
+    # delivers it on the next poll
+    with open(path, "a") as fh:
+        fh.write("}\n")
+    assert coll.poll() == 1
+    assert [r["i"] for r in coll.events if r.get("kind") == "beat"] == [0, 1]
+    counters = coll.registry.as_dict()["counters"]
+    assert counters["collector.torn_lines{host=h,process=w0}"] == 1
+
+
+def test_collector_merges_labels_and_round_trips_exposition(tmp_path):
+    d = str(tmp_path / "w0")
+    wreg = MetricsRegistry()
+    with RunLogger(d, run_id="r", registry=wreg) as run:
+        wreg.counter("fit.epochs").inc(7)
+        run.event("beat", i=0)
+    # the worker's manifest snapshot and a live registry, each re-keyed
+    # under its own host/process labels
+    live = MetricsRegistry()
+    live.gauge("fleet.loaded_tenants").set(2)
+    coll = Collector(registry=MetricsRegistry())
+    coll.watch(d, host="host-a").attach_registry(live, host="host-b",
+                                                 process="router")
+    coll.poll()
+    samples, types = parse_exposition(coll.metrics_text())
+    assert samples[("fit_epochs_total",
+                    (("host", "host-a"), ("process", "w0")))] == 7
+    assert samples[("fleet_loaded_tenants",
+                    (("host", "host-b"), ("process", "router")))] == 2
+    assert types["fit_epochs_total"] == "counter"
+    # the collector's own instruments ride alongside, labels as-is
+    assert samples[("collector_events_total",
+                    (("host", "host-a"), ("process", "w0")))] == 1
+    assert samples[("collector_sources", ())] == 2
+    assert ("collector_polls_total", ()) in samples
+
+
+def test_collector_http_metrics_healthz_and_scrape_clamp(tmp_path):
+    live = MetricsRegistry()
+    live.counter("fit.epochs").inc(3)
+    coll = Collector(slos=SLOSet(), registry=MetricsRegistry())
+    coll.attach_registry(live, host="h", process="p")
+    url = coll.serve()
+    try:
+        body = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        samples, _ = parse_exposition(body)
+        assert samples[("fit_epochs_total",
+                        (("host", "h"), ("process", "p")))] == 3
+        hz = json.loads(urllib.request.urlopen(f"{url}/healthz").read())
+        assert hz["ok"] is True and hz["exit_status"] == 0
+        assert hz["sources"] == {"run_dirs": 0, "registries": 1}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/give-me-cardinality")
+        assert ei.value.code == 404
+    finally:
+        coll.close()
+    counters = coll.registry.as_dict()["counters"]
+    assert counters["collector.scrapes{endpoint=/metrics}"] == 1
+    assert counters["collector.scrapes{endpoint=/healthz}"] == 1
+    # unknown paths are clamped to one label value, not echoed back
+    assert counters["collector.scrapes{endpoint=other}"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# trace-id continuity through the resilience paths
+# --------------------------------------------------------------------------- #
+class _StubSolver:
+    """Duck-typed stand-in for a compiled CollocationSolverND: just
+    enough surface for ResilientFit / auto_resume (losses, λ, real
+    checkpoints), with ``fit`` opening ``train.chunk`` spans under the
+    active tracer.  The property under test is the trace/flight
+    plumbing around the fit, not the PDE — the stub keeps it jit-free."""
+
+    _compiled = True
+    verbose = False
+
+    def __init__(self, diverge_at=None, preempt_at=None):
+        self.losses = []
+        self.newton_done = 0
+        self.lambdas = {"u": np.ones(2, np.float32)}
+        self.lr = 5e-3
+        self.lr_weights = 5e-3
+        self.diverge_at = diverge_at
+        self.preempt_at = preempt_at
+
+    def save_checkpoint(self, path):
+        checkpoint.save_checkpoint(str(path),
+                                   {"w": np.zeros(1, np.float32)},
+                                   meta={"epochs": len(self.losses)})
+
+    def restore_checkpoint(self, path):
+        _, meta = checkpoint.restore_checkpoint(
+            str(path), {"w": np.zeros(1, np.float32)})
+        self.losses = [{"Total Loss": 1.0}] * int(meta.get("epochs", 0))
+
+    def fit(self, tf_iter=0, newton_iter=0, checkpoint_dir=None,
+            checkpoint_every=1, telemetry=None, grad_clip=None, **kw):
+        tr = tracing.active_tracer()
+        for _ in range(int(tf_iter)):
+            epoch = len(self.losses)
+            if self.preempt_at is not None and epoch >= self.preempt_at:
+                raise Preempted("adam", epoch, flush_s=0.0)
+            with tr.span("train.chunk", epoch=epoch):
+                if self.diverge_at is not None and epoch >= self.diverge_at:
+                    self.diverge_at = None  # heal after one divergence
+                    raise TrainingDiverged("adam", epoch,
+                                           {"Total Loss": float("nan")})
+                self.losses.append({"Total Loss": 1.0 / (epoch + 1)})
+                if checkpoint_dir and \
+                        (epoch + 1) % int(checkpoint_every or 1) == 0:
+                    self.save_checkpoint(checkpoint_dir)
+        return self
+
+
+def test_retry_call_attempt_spans_share_one_trace(tmp_path):
+    d = str(tmp_path / "run")
+    reg = MetricsRegistry()
+    calls = {"n": 0}
+    with RunLogger(d, run_id="r", registry=reg), \
+            Tracer(trace_prefix="t") as tr:
+        with tr.span("serve.request") as root:
+
+            def flaky():
+                calls["n"] += 1
+                with tr.span("engine.attempt", attempt=calls["n"]):
+                    if calls["n"] < 3:
+                        raise RuntimeError(f"flake {calls['n']}")
+                    return 42
+
+            out = retry_call(flaky,
+                             RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                         jitter=0.0),
+                             name="engine", sleep=lambda s: None,
+                             registry=reg)
+    assert out == 42 and calls["n"] == 3
+    spans = tracing.read_spans(d)
+    assert {s["trace"] for s in spans} == {root.trace_id}
+    attempts = [s for s in spans if s["name"] == "engine.attempt"]
+    assert len(attempts) == 3
+    assert all(s["parent"] == root.span_id for s in attempts)
+    assert [s["status"] for s in attempts] == ["error", "error", "ok"]
+    retries = read_events(d, kind="retry")
+    assert len(retries) == 3 and retries[-1]["recovered"] is True
+    assert reg.as_dict()["counters"][
+        "resilience.retry.recovered{op=engine}"] == 1
+
+
+def test_resilient_fit_rollback_keeps_one_trace_and_flushes_flight(tmp_path):
+    d = str(tmp_path / "run")
+    ck = str(tmp_path / "ck")
+    reg = MetricsRegistry()
+    stub = _StubSolver(diverge_at=3)
+    with RunLogger(d, run_id="r", registry=reg), \
+            Tracer(trace_prefix="t") as tr, \
+            FlightRecorder(d, registry=reg):
+        with tr.span("resilient.fit") as root:
+            rf = ResilientFit(stub, ck, checkpoint_every=2, max_retries=2,
+                              telemetry=None)
+            rf.fit(tf_iter=5)
+    assert len(stub.losses) == 5 and rf.recoveries == 1
+
+    # every span of every leg — through the rollback — is ONE trace
+    spans = tracing.read_spans(d)
+    assert {s["trace"] for s in spans} == {root.trace_id}
+    chunks = [s for s in spans if s["name"] == "train.chunk"]
+    assert all(s["parent"] == root.span_id for s in chunks)
+    epochs = [s["attrs"]["epoch"] for s in chunks]
+    assert epochs == [0, 1, 2, 3, 2, 3, 4]  # leg 1, diverge@3, leg 2
+    diverged = [s for s in chunks if s["status"] == "error"]
+    assert len(diverged) == 1 and "TrainingDiverged" in diverged[0]["error"]
+
+    # the rollback narration and the flight dump both carry the story
+    rb = read_events(d, kind="rollback")
+    assert len(rb) == 1 and rb[0]["restored_epoch"] == 2
+    secs = flight_sections(d)
+    assert secs[-1]["header"]["reason"] == "training_diverged"
+    assert "TrainingDiverged" in secs[-1]["header"]["error"]
+    ring_traces = [r for r in secs[-1]["records"] if r.get("kind") == "trace"]
+    # the ring's FINAL span is the chunk that diverged
+    assert ring_traces[-1]["name"] == "train.chunk"
+    assert ring_traces[-1]["status"] == "error"
+    assert ring_traces[-1]["attrs"]["epoch"] == 3
+
+
+def test_auto_resume_env_round_trip_joins_original_trace(tmp_path):
+    """A preempted generation's trace context survives a full env
+    round-trip (what ClusterSupervisor stamps at relaunch): the resumed
+    generation's spans join the ORIGINAL trace, under the original
+    span."""
+    ck = str(tmp_path / "ck")
+    d1, d2 = str(tmp_path / "gen0"), str(tmp_path / "gen1")
+    env = {}
+
+    stub = _StubSolver(preempt_at=2)
+    reg1 = MetricsRegistry()
+    with RunLogger(d1, run_id="g0", registry=reg1) as run1, \
+            Tracer(trace_prefix="job", logger=run1) as tr1, \
+            FlightRecorder(d1, registry=reg1):
+        with tr1.span("cluster.launch") as launch:
+            env[TRACE_CONTEXT_ENV] = tr1.context(launch)
+            try:
+                auto_resume(stub, ck, tf_iter=5, checkpoint_every=1)
+            except Preempted as e:
+                # logger=None: the with-block owns the close here — the
+                # launch span above still has to land in this run log
+                rc = handle_preemption(e, logger=None, exit_process=False)
+    assert rc == RESUMABLE_EXIT_CODE
+    assert flight_sections(d1)[-1]["header"]["reason"] == "preempted"
+
+    # "relaunch": a fresh process would build its tracer from the env
+    stub2 = _StubSolver()
+    with RunLogger(d2, run_id="g1", registry=MetricsRegistry()) as run2, \
+            Tracer.from_env(env, logger=run2) as tr2:
+        with tr2.span("host.join"):
+            auto_resume(stub2, ck, tf_iter=5, checkpoint_every=1)
+    assert len(stub2.losses) == 5
+
+    # the resumed generation fast-forwarded instead of retraining
+    resume = read_events(d2, kind="resume")
+    assert len(resume) == 1 and resume[0]["epochs_done"] == 2
+    chunk_epochs = [s["attrs"]["epoch"] for s in tracing.read_spans(d2)
+                    if s["name"] == "train.chunk"]
+    assert chunk_epochs == [2, 3, 4]
+
+    # continuity: gen1's spans live in gen0's trace, rooted under launch
+    spans2 = tracing.read_spans(d2)
+    assert {s["trace"] for s in spans2} == {launch.trace_id}
+    union = tracing.read_spans(d1) + spans2
+    roots = tracing.span_tree(union)[launch.trace_id]
+    assert [r["name"] for r in roots] == ["cluster.launch"]
+    assert "host.join" in [c["name"] for c in roots[0]["children"]]

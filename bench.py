@@ -2761,6 +2761,166 @@ def bench_elastic():
     return payload
 
 
+FLEETHA_BOOTSTRAP = '''\
+"""Replica bootstrap for bench.py --mode fleetha (written to a temp dir
+and imported by each replica worker via --bootstrap)."""
+import os
+
+from tensordiffeq_tpu import grad
+from tensordiffeq_tpu.fleet import FleetRouter, TenantPolicy
+
+ART = {arts!r}
+
+
+def f_model(u, x, t):
+    u_xx = grad(grad(u, "x"), "x")
+    u_t = grad(u, "t")
+    uv = u(x, t)
+    return u_t(x, t) - {eps!r} * u_xx(x, t) + 5.0 * uv ** 3 - 5.0 * uv
+
+
+def make_router():
+    router = FleetRouter(max_loaded=4)
+    for name in ("t0", "t1"):
+        router.register(
+            name, os.path.join(ART, name),
+            policy=TenantPolicy(min_bucket={min_b}, max_bucket={max_b},
+                                max_batch=256, max_latency_s=0.005),
+            f_model=f_model)
+    return router
+'''
+
+
+def _fleetha_compiles(run_dir):
+    """Sum of ``serving.engine.compiles*`` counters in a replica run
+    dir's live metrics snapshot (written atomically at every beat, last
+    of them right before exit)."""
+    from tensordiffeq_tpu.telemetry.collector import SNAPSHOT_FILE
+    try:
+        with open(os.path.join(run_dir, SNAPSHOT_FILE)) as fh:
+            counters = (json.load(fh).get("metrics") or {}).get(
+                "counters") or {}
+    except (OSError, ValueError):
+        return None
+    return sum(v for k, v in counters.items()
+               if k.startswith("serving.engine.compiles"))
+
+
+def bench_fleetha():
+    """``--mode fleetha``: the replicated-serving failover drill,
+    end-to-end on a REAL 2-replica group (separate processes, stdlib
+    HTTP, CPU jax):
+
+    * two tiny fleet artifacts (tenants t0/t1) export in the driver and
+      warm-start in every replica;
+    * a chaos ``host_loss_at`` hard-kills replica 1 at its Nth request —
+      mid-traffic, connections dropped, no drain;
+    * the :class:`~tensordiffeq_tpu.fleet.FrontRouter` fails the dropped
+      requests over (breaker + rendezvous rehash) while the serving-mode
+      :class:`~tensordiffeq_tpu.resilience.ClusterSupervisor` respawns
+      the slot warm from the shared artifacts;
+    * headline ``value`` = query p99 across the whole incident;
+      ``requests_lost`` MUST be 0 (no query the front tier gave up on),
+      ``request_time_compiles_survivor`` MUST be 0 (the survivor absorbs
+      the rerouted tenants without a single request-time compile).
+
+    Driver-process mode like ``--elastic``: spawns its own CPU
+    subprocesses, no accelerator probe, no TPU cache."""
+    import tempfile
+
+    from tensordiffeq_tpu import fleet
+    from tensordiffeq_tpu.fleet.replica import (FrontRouter, ReplicaGroup,
+                                                ReplicaUnavailable)
+    from tensordiffeq_tpu.telemetry import default_registry
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_queries = 40 if fast else 200
+    loss_at = max(5, n_queries // 4)
+    min_b, max_b = 64, 128
+    chaos_spec = f"host_loss_at={loss_at},host_loss_rank=1"
+
+    work = tempfile.mkdtemp(prefix="tdq_fleetha_bench_")
+    arts = os.path.join(work, "artifacts")
+    for i in range(2):
+        solver = build_solver(64, 16, 8, [8, 8], seed=i)
+        fleet.export_fleet_artifact(
+            solver.export_surrogate(), os.path.join(arts, f"t{i}"),
+            min_bucket=min_b, max_bucket=max_b)
+    boot_dir = os.path.join(work, "boot")
+    os.makedirs(boot_dir, exist_ok=True)
+    with open(os.path.join(boot_dir, "tdq_fleetha_boot.py"), "w") as fh:
+        fh.write(FLEETHA_BOOTSTRAP.format(arts=arts, eps=EPS,
+                                          min_b=min_b, max_b=max_b))
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {"PYTHONPATH": boot_dir + os.pathsep + repo,
+           "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+           "TDQ_CHAOS": chaos_spec}
+    payload = {
+        "metric": "replicated serving failover: 2 replicas, "
+                  "host loss mid-traffic",
+        "value": None, "unit": "s (query p99 across the incident)",
+        "vs_baseline": None, "chaos": chaos_spec,
+        "requests_total": n_queries,
+    }
+    budget = float(os.environ.get("BENCH_BUDGET", "900"))
+    t0_all = time.time()
+    group = ReplicaGroup("tdq_fleetha_boot:make_router", nproc=2,
+                         workdir=os.path.join(work, "replicas"),
+                         heartbeat_timeout_s=180.0, max_relaunches=2,
+                         env=env)
+    group.start(timeout_s=budget)
+    group.wait_ready(timeout_s=min(300.0, budget))
+    survivor_dir = os.path.join(group.workdir, "replica0.gen0")
+    survivor_base = _fleetha_compiles(survivor_dir)
+    front = FrontRouter(group.endpoints(), deadline_s=30.0,
+                        breaker_reset_timeout_s=2.0)
+
+    rng = np.random.RandomState(0)
+    lat, lost, avail_min = [], 0, 1.0
+    for i in range(n_queries):
+        X = np.stack([rng.uniform(-1.0, 1.0, min_b),
+                      rng.uniform(0.0, 1.0, min_b)], -1).astype(np.float32)
+        t0 = time.time()
+        try:
+            front.query(f"t{i % 2}", X, kind="u" if i % 3 else "residual")
+        except ReplicaUnavailable:
+            lost += 1
+        lat.append(time.time() - t0)
+        avail_min = min(avail_min, front.availability())
+    # the respawned slot must come back WARM before the goodbye — its
+    # first beat is what closes the supervisor's recovery-wall clock
+    group.wait_ready(timeout_s=min(300.0, budget))
+    result = group.shutdown(timeout_s=120.0)
+
+    lat_sorted = sorted(lat)
+    p99 = lat_sorted[min(len(lat) - 1, int(0.99 * len(lat)))]
+    payload["value"] = round(p99, 4)
+    payload["failover_max_s"] = round(lat_sorted[-1], 4)
+    payload["median_s"] = round(lat_sorted[len(lat) // 2], 6)
+    payload["requests_lost"] = lost
+    payload["availability_min"] = round(avail_min, 3)
+    payload["hosts_lost"] = result.hosts_lost
+    payload["relaunches"] = result.relaunches
+    payload["recovery_wall_s"] = (round(result.recovery_wall_s[0], 3)
+                                  if result.recovery_wall_s else None)
+    ctr = default_registry().as_dict()["counters"]
+    payload["reroutes"] = int(ctr.get("fleet.failover.reroutes", 0))
+    payload["failover_attempts"] = sum(
+        v for k, v in ctr.items()
+        if k.startswith("fleet.failover.attempts"))
+    survivor_final = _fleetha_compiles(survivor_dir)
+    payload["request_time_compiles_survivor"] = (
+        None if survivor_base is None or survivor_final is None
+        else int(survivor_final - survivor_base))
+    payload["wall_s"] = round(time.time() - t0_all, 3)
+    log(f"[fleetha] lost={lost}/{n_queries} p99={p99 * 1e3:.1f}ms "
+        f"reroutes={payload['reroutes']} recovery="
+        f"{payload['recovery_wall_s']}s survivor_compiles="
+        f"{payload['request_time_compiles_survivor']}")
+    return payload
+
+
 def lint_verdict():
     """``bench.py --lint`` body: the tdqlint AST pass over the package +
     bench.py (tensordiffeq_tpu.analysis), as a machine-readable verdict
@@ -3118,7 +3278,8 @@ def main():
                                        "precision", "minimax", "scale",
                                        "remat", "serving", "fleet",
                                        "resample", "factory",
-                                       "closedloop", "zoo", "obs"],
+                                       "closedloop", "zoo", "obs",
+                                       "fleetha"],
                     help="alternative spelling of the mode flags: "
                          "--mode serving == --serving")
     ap.add_argument("--slo", metavar="TARGET",
@@ -3139,6 +3300,13 @@ def main():
                          "host_loss_at, and report the supervisor's "
                          "recovery wall time + post-resume throughput "
                          "delta (CPU-only by design; no TPU cache)")
+    ap.add_argument("--fleetha", action="store_true",
+                    help="replicated-serving failover drill: run a real "
+                         "2-replica serving group, hard-kill one replica "
+                         "via chaos host_loss_at mid-traffic, and report "
+                         "failover p99 / requests lost (must be 0) / "
+                         "supervisor recovery wall (CPU-only by design; "
+                         "no TPU cache)")
     ap.add_argument("--chaos", metavar="SPEC",
                     help="activate deterministic fault injection for the "
                          "worker run (tensordiffeq_tpu.resilience.Chaos "
@@ -3186,6 +3354,20 @@ def main():
             payload = {"metric": "elastic recovery: 2-host cluster, host "
                        "loss mid-run", "value": None, "unit": None,
                        "vs_baseline": None,
+                       "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(payload))
+        return
+
+    if args.fleetha:
+        # driver-process mode like --elastic: spawns its own CPU replica
+        # subprocesses (no accelerator probe, no worker protocol, no TPU
+        # cache) — the one-JSON-line / exit-0 contract still holds
+        try:
+            payload = bench_fleetha()
+        except Exception as e:  # noqa: BLE001 — contract: always emit
+            payload = {"metric": "replicated serving failover: 2 "
+                       "replicas, host loss mid-traffic", "value": None,
+                       "unit": None, "vs_baseline": None,
                        "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(payload))
         return

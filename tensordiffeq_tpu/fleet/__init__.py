@@ -18,6 +18,16 @@ gauges, latency histograms, cache hit/miss/eviction counters — publish
 through the shared telemetry registry
 (:meth:`FleetRouter.autoscale_signals` distils them).
 
+The serving plane replicates in :mod:`~tensordiffeq_tpu.fleet.replica`:
+a :class:`ReplicaGroup` runs N router processes (each the full tenant
+set, warm-started from the shared artifact directory) under a
+serving-mode :class:`~tensordiffeq_tpu.resilience.ClusterSupervisor`
+that respawns a lost replica in place, and a :class:`FrontRouter`
+rendezvous-hashes tenants onto replicas with per-replica circuit
+breakers, retrying failover, optional hedged requests, and
+below-quorum graceful degradation — chaos-drilled so one replica's
+death loses zero requests.
+
 The loop closes in :mod:`~tensordiffeq_tpu.fleet.closedloop`: a
 :class:`DriftMonitor` shadow-samples live traffic through the residual
 kind and trips the ``residual_drift`` SLO, a :class:`RetrainController`
@@ -46,6 +56,9 @@ Typical flow::
 from .admission import (PRIORITIES, AdmissionController,  # noqa: F401
                         AdmissionRejected)
 from .closedloop import DriftMonitor, RetrainController  # noqa: F401
+from .replica import (FrontRouter, ReplicaGroup,  # noqa: F401
+                      ReplicaRequestError, ReplicaServer,
+                      ReplicaUnavailable, decode_array, encode_array)
 from .router import (FleetRouter, LoadedTenant,  # noqa: F401
                      TenantEvicted, TenantPolicy)
 from .warmstart import (AOT_SUBDIR, DEFAULT_KINDS,  # noqa: F401

@@ -121,8 +121,44 @@ class AdmissionController:
         self._clock = clock
         self._buckets: dict = {}
         self._limits: dict = {}
+        self._nominal: Optional[tuple] = None  # set lazily by degrade()
         self._metrics = (registry if registry is not None
                          else default_registry())
+
+    # ------------------------------------------------------------------ #
+    def degrade(self, factor: float = 0.5) -> None:
+        """Tighten the fleet-wide watermarks to ``factor`` of their
+        NOMINAL values (graceful degradation below replica quorum: with
+        half the group gone, half the queue capacity keeps per-request
+        latency honest instead of letting survivors drown).  Relative to
+        the nominal configuration, so repeated calls are idempotent and
+        re-degrading at a different factor never compounds."""
+        if not 0.0 < float(factor) <= 1.0:
+            raise ValueError(f"factor must be in (0, 1], got {factor}")
+        if self._nominal is None:
+            self._nominal = (self.max_pending_points, self.shed_watermark)
+        nom_cap, nom_shed = self._nominal
+        self.max_pending_points = max(1, int(nom_cap * float(factor)))
+        self.shed_watermark = nom_shed * float(factor)
+        self._metrics.gauge("fleet.admission.degraded").set(1)
+        log_event("admission", f"degraded watermarks to {factor:.0%} of "
+                  f"nominal (capacity {self.max_pending_points}, shed at "
+                  f"{self.shed_watermark:.0%})", level="warning",
+                  verbose=False, factor=float(factor),
+                  max_pending_points=self.max_pending_points)
+
+    def restore(self) -> None:
+        """Undo :meth:`degrade`: watermarks back to nominal (no-op when
+        never degraded)."""
+        if self._nominal is None:
+            return
+        self.max_pending_points, self.shed_watermark = self._nominal
+        self._nominal = None
+        self._metrics.gauge("fleet.admission.degraded").set(0)
+        log_event("admission", "restored nominal watermarks (capacity "
+                  f"{self.max_pending_points}, shed at "
+                  f"{self.shed_watermark:.0%})", verbose=False,
+                  max_pending_points=self.max_pending_points)
 
     # ------------------------------------------------------------------ #
     def configure(self, tenant: str, *, rate_qps: Optional[float] = None,

@@ -209,6 +209,19 @@ class LoadedTenant:
     def stats(self) -> dict:
         return {spec: b.stats() for spec, b in self._batchers.items()}
 
+    def snapshot(self) -> dict:
+        """One CONSISTENT observation of this tenant: per-kind batcher
+        stats and the pending-point total captured from the SAME
+        per-batcher snapshots (:meth:`RequestBatcher.snapshot`), so a
+        flush racing the scrape can never tear the two apart."""
+        snaps = {spec: b.snapshot()
+                 for spec, b in tuple(self._batchers.items())}
+        return {
+            "kinds": {spec: s["stats"] for spec, s in snaps.items()},
+            "pending_points": sum(s["pending_points"]
+                                  for s in snaps.values()),
+        }
+
 
 class FleetRouter:
     """Route multi-tenant surrogate queries; see the module docstring.
@@ -666,21 +679,48 @@ class FleetRouter:
         self.collector = c
         return c
 
+    def drain(self) -> int:
+        """Planned-shutdown drain: flush every live tenant's pending
+        batches, then fail-fast whatever could not execute (open
+        breakers) — the zero-dropped-waiter contract :meth:`hot_swap`
+        applies to one engine flip, applied to the whole process.  A
+        replica worker calls this BEFORE exiting so in-flight
+        ``PendingQuery`` handles complete instead of dying with the
+        process.  Returns the pending-point count that was outstanding
+        when the drain began."""
+        owed = self.pending_points()
+        for lt in list(self._loaded.values()):
+            lt.drain()
+        log_event("fleet", f"drained {owed} pending point(s) across "
+                  f"{len(self._loaded)} live tenant(s)", verbose=False,
+                  event="drain", pending_points=owed)
+        return owed
+
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
-        """Cache tallies + per-tenant load state and batcher stats."""
+        """Cache tallies + per-tenant load state and batcher stats.
+        Built from ONE capture of the loaded-tenant table and one
+        :meth:`LoadedTenant.snapshot` per live tenant, so a flush racing
+        the scrape (the replica beat thread, a collector poll) cannot
+        tear the per-tenant numbers mid-read."""
+        loaded = dict(self._loaded)
+        tenants = {}
+        for t in self._registered:
+            lt = loaded.get(t)
+            if lt is None:
+                tenants[t] = {"loaded": False}
+                continue
+            tenants[t] = {
+                "loaded": True,
+                "kinds": lt.snapshot()["kinds"],
+                "quarantined": lt.engine.quarantined_buckets(),
+                "warm": lt.warm,
+            }
         return {
             "max_loaded": self.max_loaded,
             "hits": self._hits, "misses": self._misses,
             "evictions": self._evictions,
-            "tenants": {
-                t: {"loaded": t in self._loaded,
-                    **({"kinds": self._loaded[t].stats(),
-                        "quarantined":
-                            self._loaded[t].engine.quarantined_buckets(),
-                        "warm": self._loaded[t].warm}
-                       if t in self._loaded else {})}
-                for t in self._registered},
+            "tenants": tenants,
         }
 
     def autoscale_signals(self) -> dict:
@@ -690,25 +730,31 @@ class FleetRouter:
         raise max_loaded' signal; all-zero queue depths with idle
         tenants is the scale-down one), and the :class:`SLOSet` verdict
         over the router's registry — scale on burn rate before the
-        breach, not after."""
+        breach, not after.  One :meth:`LoadedTenant.snapshot` per tenant
+        feeds BOTH the per-tenant rows and the fleet ``pending_points``
+        total, so the total always equals the sum of the reported queue
+        depths even while batchers flush concurrently."""
         tenants = {}
-        for t, lt in self._loaded.items():
-            agg = lt.stats()
+        fleet_pending = 0
+        for t, lt in tuple(self._loaded.items()):
+            snap = lt.snapshot()
+            agg = snap["kinds"]
             lat = [s["latency_s"] for s in agg.values()
                    if s.get("latency_s", {}).get("p99") is not None]
             tenants[t] = {
-                "queue_depth": lt.pending_points(),
+                "queue_depth": snap["pending_points"],
                 "qps": sum(s["qps"] or 0.0 for s in agg.values()),
                 "latency_p99_s": max((p["p99"] for p in lat),
                                      default=None),
                 "breaker": None if lt.breaker is None else lt.breaker.state,
             }
+            fleet_pending += snap["pending_points"]
         total = self._hits + self._misses
         return {
-            "loaded": len(self._loaded), "max_loaded": self.max_loaded,
+            "loaded": len(tenants), "max_loaded": self.max_loaded,
             "cache_hit_rate": (self._hits / total) if total else None,
             "evictions": self._evictions,
-            "pending_points": self.pending_points(),
+            "pending_points": fleet_pending,
             "tenants": tenants,
             "slo": self.slo.evaluate(self._registry),
         }

@@ -76,6 +76,21 @@ Cluster faults (the elastic multi-host failure model —
   properly above the stall must NOT declare a loss (the
   false-positive-relaunch guard).
 
+Replicated-serving faults (the front-tier failure model —
+:class:`~tensordiffeq_tpu.fleet.ReplicaGroup` /
+:class:`~tensordiffeq_tpu.fleet.FrontRouter`):
+
+* ``host_loss_at`` doubles as a SERVING fault: a replica worker whose
+  rank equals ``host_loss_rank`` hard-exits with
+  :data:`HOST_LOSS_EXIT_CODE` at its Nth request (no drain, no goodbye —
+  in-flight HTTP connections drop).  The supervisor's liveness beat
+  catches the exit; the front router's breaker catches the dropped
+  requests and fails them over.
+* ``replica_net_partition`` — from the Nth request on, the replica stops
+  ANSWERING for ``replica_partition_s`` seconds while staying alive and
+  beating: the case liveness beats cannot see.  Only the front router's
+  per-replica circuit breaker (transport-level failures) detects it.
+
 Closed-loop faults (the drift → retrain → hot-swap cycle —
 :mod:`tensordiffeq_tpu.fleet.closedloop`):
 
@@ -184,7 +199,9 @@ class Chaos:
                  drift_inject: float = 0.0,
                  retrain_kill_at: Optional[int] = None,
                  retrain_kill_repeats: int = 1,
-                 swap_corrupt_member: Optional[int] = None):
+                 swap_corrupt_member: Optional[int] = None,
+                 replica_net_partition: Optional[int] = None,
+                 replica_partition_s: float = 2.0):
         if not 0.0 <= float(serving_fail_rate) <= 1.0:
             raise ValueError(
                 f"serving_fail_rate must be in [0, 1], got {serving_fail_rate}")
@@ -211,6 +228,9 @@ class Chaos:
         self.retrain_kill_at = retrain_kill_at
         self.retrain_kill_repeats = int(retrain_kill_repeats)
         self.swap_corrupt_member = swap_corrupt_member
+        self.replica_net_partition = replica_net_partition
+        self.replica_partition_s = float(replica_partition_s)
+        self._partition_until: Optional[float] = None
         self._rng = np.random.RandomState(self.seed)
         # fire bookkeeping (all monotonic counters, exposed for tests/report)
         self.fired: dict[str, int] = {"nan": 0, "preempt": 0,
@@ -219,7 +239,8 @@ class Chaos:
                                       "fleet_evict": 0, "warmstart": 0,
                                       "host_loss": 0, "coordinator_timeout": 0,
                                       "dcn_stall": 0, "drift_inject": 0,
-                                      "retrain_kill": 0, "swap_corrupt": 0}
+                                      "retrain_kill": 0, "swap_corrupt": 0,
+                                      "replica_partition": 0}
         self._serving_ops = 0
         self._checkpoints = 0
         self._fleet_accesses = 0
@@ -251,7 +272,8 @@ class Chaos:
             if key == "compile_fail_buckets":
                 kwargs[key] = [int(v) for v in val.split("+") if v]
             elif key in ("serving_fail_rate", "coordinator_timeout_s",
-                         "dcn_stall_s", "drift_inject"):
+                         "dcn_stall_s", "drift_inject",
+                         "replica_partition_s"):
                 kwargs[key] = float(val)
             else:
                 kwargs[key] = int(val)
@@ -279,7 +301,9 @@ class Chaos:
                              ("drift_inject", 0.0),
                              ("retrain_kill_at", None),
                              ("retrain_kill_repeats", 1),
-                             ("swap_corrupt_member", None)):
+                             ("swap_corrupt_member", None),
+                             ("replica_net_partition", None),
+                             ("replica_partition_s", 2.0)):
             v = getattr(self, key)
             if v != default:
                 parts.append(f"{key}={v:g}" if isinstance(v, float)
@@ -459,6 +483,63 @@ class Chaos:
             raise ChaosFault(
                 f"injected corrupt AOT program for kind={kind} "
                 f"bucket={bucket} (load #{self._warmstart_loads})")
+
+    # ------------------------------------------------------------------ #
+    def on_replica_request(self, n: int, rank: int = 0) -> bool:
+        """Replica-server per-request hook (``n`` = this replica's request
+        ordinal, ``rank`` = its slot in the group).  Two faults:
+        ``host_loss_at`` hard-exits the ``host_loss_rank`` replica at its
+        Nth request (the serving twin of the training host loss — no
+        drain, no goodbye); ``replica_net_partition`` returns True while
+        the replica should DROP requests unanswered (alive, beating,
+        unreachable) for ``replica_partition_s`` seconds from its Nth
+        request.
+
+        The host loss only fires in incarnation 0 of the slot
+        (``TDQ_CLUSTER_GENERATION``): the fault models ONE host dying,
+        and unlike the training path — where the relaunch shrinks the
+        topology so ``host_loss_rank`` stops existing — a serving
+        respawn keeps its rank, so a fresh process re-reading the same
+        ``TDQ_CHAOS`` spec would otherwise die again, forever."""
+        incarnation = int(os.environ.get("TDQ_CLUSTER_GENERATION", "0")
+                          or 0)
+        if self.host_loss_at is not None and rank == self.host_loss_rank \
+                and incarnation == 0 \
+                and self._trip("host_loss", self.host_loss_at, n, 1):
+            log_event("chaos", f"injected host loss: serving replica rank "
+                      f"{rank} exiting at request #{n}", level="warning",
+                      verbose=False, fault="host_loss", phase="serve",
+                      epoch=n, rank=rank)
+            # same hard-kill contract as the training path: os._exit
+            # bypasses atexit, so flush the flight ring and stdio first
+            from ..telemetry.flight import flush_flight
+            flush_flight("host_loss")
+            import sys
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(HOST_LOSS_EXIT_CODE)
+        if self.replica_net_partition is not None \
+                and n >= int(self.replica_net_partition):
+            import time
+            if self._partition_until is None:
+                self.fired["replica_partition"] += 1
+                self._partition_until = time.time() + self.replica_partition_s
+                log_event("chaos", f"injected network partition: replica "
+                          f"rank {rank} unreachable for "
+                          f"{self.replica_partition_s:g}s from request "
+                          f"#{n}", level="warning", verbose=False,
+                          fault="replica_partition", epoch=n, rank=rank,
+                          stall_s=self.replica_partition_s)
+            if time.time() < self._partition_until:
+                return True
+        return False
+
+    def replica_partition_active(self) -> bool:
+        """Whether an injected network partition is currently dropping
+        this replica's requests (read-only; never arms the fault)."""
+        import time
+        return (self._partition_until is not None
+                and time.time() < self._partition_until)
 
     # ------------------------------------------------------------------ #
     def on_drift_probe(self, tenant) -> Optional[float]:

@@ -195,6 +195,17 @@ class ClusterSupervisor:
       max_relaunches: relaunch budget; exhaustion raises :class:`HostLost`.
       min_hosts: refuse to relaunch below this many hosts (default 1).
       env: extra environment for every worker (e.g. a ``TDQ_CHAOS`` spec).
+      relaunch_scope: ``"generation"`` (default) is the training-plane
+        gang semantics above — one lost host drains the whole generation
+        and relaunches on the surviving count, because a collective job
+        cannot run with a hole in it.  ``"worker"`` is the serving-plane
+        semantics (:class:`~tensordiffeq_tpu.fleet.ReplicaGroup`):
+        workers are independent replicas, so a lost one is respawned IN
+        PLACE (same slot, same argv builder, a fresh per-slot
+        incarnation for its heartbeat/log files) while its peers keep
+        serving untouched — no gang drain, no topology shrink.  Exit 75
+        also respawns in place but counts neither a host loss nor the
+        lost-host recovery clock (it is a preemption, not a failure).
       tracer: optional :class:`~tensordiffeq_tpu.telemetry.Tracer` — emits
         the ``cluster.launch > host.join / host.lost / reshard.restore``
         span tree into its run log.
@@ -208,7 +219,11 @@ class ClusterSupervisor:
                  heartbeat_timeout_s: float = 60.0, poll_s: float = 0.2,
                  grace_s: float = 15.0, max_relaunches: int = 2,
                  min_hosts: int = 1, env: Optional[dict] = None,
-                 tracer=None, registry=None, verbose: bool = False):
+                 tracer=None, registry=None, verbose: bool = False,
+                 relaunch_scope: str = "generation"):
+        if relaunch_scope not in ("generation", "worker"):
+            raise ValueError("relaunch_scope must be 'generation' or "
+                             f"'worker', got {relaunch_scope!r}")
         self.worker_cmd = worker_cmd
         self.nproc = int(nproc)
         self.workdir = str(workdir)
@@ -218,6 +233,7 @@ class ClusterSupervisor:
         self.max_relaunches = int(max_relaunches)
         self.min_hosts = int(min_hosts)
         self.env = dict(env or {})
+        self.relaunch_scope = str(relaunch_scope)
         self.tracer = tracer
         self.registry = registry if registry is not None else default_registry()
         self.verbose = bool(verbose)
@@ -225,38 +241,44 @@ class ClusterSupervisor:
         os.makedirs(self.workdir, exist_ok=True)
 
     # ------------------------------------------------------------------ #
+    def _spawn_worker(self, gen: int, pid: int, nproc: int,
+                      port: int) -> _Worker:
+        """Spawn ONE worker slot (generation semantics name files by
+        generation; worker-scope respawns reuse this with a per-slot
+        incarnation number as ``gen``)."""
+        hb = os.path.join(self.workdir, f"gen{gen}.hb{pid}")
+        try:
+            os.remove(hb)
+        except OSError:
+            pass
+        out_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.out")
+        err_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.err")
+        env = dict(os.environ, **self.env)
+        env[_HB_ENV] = hb
+        env["TDQ_CLUSTER_GENERATION"] = str(gen)
+        env["TDQ_CLUSTER_NPROC"] = str(nproc)
+        if self.tracer is not None:
+            # cross-process trace context: the open cluster.launch
+            # span becomes the parent of every worker-side root, so
+            # cluster.launch > host.join > train.step is ONE trace
+            # across the supervisor and all generations' workers
+            ctx = self.tracer.context()
+            if ctx:
+                env[TRACE_CONTEXT_ENV] = ctx
+        argv = [str(a) for a in self.worker_cmd(pid, nproc, port)]
+        # stderr/stdout go to FILES, not pipes: the supervisor never
+        # reads them inline, so a chatty worker cannot fill a pipe and
+        # deadlock against the monitor loop
+        with open(out_p, "wb") as out_f, open(err_p, "wb") as err_f:
+            proc = subprocess.Popen(argv, stdout=out_f, stderr=err_f,
+                                    env=env, cwd=self.workdir)
+        return _Worker(pid, proc, hb, out_p, err_p,
+                       time.monotonic(), time.time())
+
     def _spawn_generation(self, gen: int, nproc: int) -> tuple:
         port = free_port()
-        workers = []
-        for pid in range(nproc):
-            hb = os.path.join(self.workdir, f"gen{gen}.hb{pid}")
-            try:
-                os.remove(hb)
-            except OSError:
-                pass
-            out_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.out")
-            err_p = os.path.join(self.workdir, f"gen{gen}.worker{pid}.err")
-            env = dict(os.environ, **self.env)
-            env[_HB_ENV] = hb
-            env["TDQ_CLUSTER_GENERATION"] = str(gen)
-            env["TDQ_CLUSTER_NPROC"] = str(nproc)
-            if self.tracer is not None:
-                # cross-process trace context: the open cluster.launch
-                # span becomes the parent of every worker-side root, so
-                # cluster.launch > host.join > train.step is ONE trace
-                # across the supervisor and all generations' workers
-                ctx = self.tracer.context()
-                if ctx:
-                    env[TRACE_CONTEXT_ENV] = ctx
-            argv = [str(a) for a in self.worker_cmd(pid, nproc, port)]
-            # stderr/stdout go to FILES, not pipes: the supervisor never
-            # reads them inline, so a chatty worker cannot fill a pipe and
-            # deadlock against the monitor loop
-            with open(out_p, "wb") as out_f, open(err_p, "wb") as err_f:
-                proc = subprocess.Popen(argv, stdout=out_f, stderr=err_f,
-                                        env=env, cwd=self.workdir)
-            workers.append(_Worker(pid, proc, hb, out_p, err_p,
-                                   time.monotonic(), time.time()))
+        workers = [self._spawn_worker(gen, pid, nproc, port)
+                   for pid in range(nproc)]
         log_event("cluster", f"generation {gen}: launched {nproc} worker"
                   f"{'s' if nproc != 1 else ''} on port {port}",
                   verbose=self.verbose, logger=getattr(self.tracer,
@@ -319,6 +341,8 @@ class ClusterSupervisor:
         """Drive the job to completion (all workers exit 0), relaunching
         through host losses; raises :class:`HostLost` when the relaunch
         budget (or ``timeout_s``) runs out with the job unfinished."""
+        if self.relaunch_scope == "worker":
+            return self._run_solo(timeout_s)
         result = ClusterResult()
         deadline = time.monotonic() + float(timeout_s)
         gen, nproc = 0, self.nproc
@@ -394,6 +418,130 @@ class ClusterSupervisor:
                       verbose=self.verbose,
                       logger=getattr(self.tracer, "_logger", None),
                       generation=gen, nproc=nproc, level="warning")
+
+    # ------------------------------------------------------------------ #
+    def _run_solo(self, timeout_s: float) -> ClusterResult:
+        """Serving-plane loop (``relaunch_scope="worker"``): each slot is
+        an independent replica, so a lost one is respawned IN PLACE while
+        its peers keep serving — no gang drain, no topology shrink.  One
+        :class:`GenerationReport` covers the whole run; per-slot respawns
+        bump a private incarnation counter for fresh heartbeat/log
+        files."""
+        result = ClusterResult()
+        deadline = time.monotonic() + float(timeout_s)
+        port = free_port()  # advisory: replica argv builders pin their own
+        launch_span = None
+        if self.tracer is not None:
+            launch_span = self.tracer.open_span(
+                "cluster.launch", parent=None, scope="worker",
+                nproc=self.nproc)
+        workers = {pid: self._spawn_worker(0, pid, self.nproc, port)
+                   for pid in range(self.nproc)}
+        incarnation = {pid: 0 for pid in workers}
+        # pid -> monotonic loss-detection time, resolved to a
+        # recovery_wall_s entry at the respawned slot's first beat
+        pending_recovery: dict = {}
+        report = GenerationReport(0, self.nproc, port)
+        result.generations.append(report)
+        self.registry.counter("cluster.launches").inc()
+        self.registry.gauge("cluster.hosts").set(self.nproc)
+        log_event("cluster", f"replica group: launched {self.nproc} worker"
+                  f"{'s' if self.nproc != 1 else ''}",
+                  verbose=self.verbose,
+                  logger=getattr(self.tracer, "_logger", None),
+                  nproc=self.nproc, scope="worker")
+        t0 = time.monotonic()
+        try:
+            while True:
+                now = time.monotonic()
+                for pid, w in workers.items():
+                    w.sample()
+                    if not w.beaten and w.last_beat() is not None:
+                        w.beaten = True
+                        if report.first_beat_s is None:
+                            report.first_beat_s = now - w.spawned_at
+                        if pid in pending_recovery:
+                            result.recovery_wall_s.append(
+                                now - pending_recovery.pop(pid))
+                        if self.tracer is not None:
+                            self.tracer.record_span(
+                                "host.join", duration_s=now - w.spawned_at,
+                                parent=launch_span, pid=pid,
+                                generation=incarnation[pid])
+                # loss detection: non-(0,75) exit, or stale beat while
+                # running.  No peer-blocked watchdog — replicas are
+                # independent, nobody waits on a coordinator.
+                for pid, w in list(workers.items()):
+                    rc = w.proc.poll()
+                    reason = None
+                    if rc is not None and rc not in (0, 75):
+                        reason = "exit"
+                    elif rc is None and \
+                            w.beat_age_s() > self.heartbeat_timeout_s:
+                        reason = "heartbeat"
+                    preempted = reason is None and rc == 75
+                    if reason is None and not preempted:
+                        continue
+                    if reason is not None:
+                        w.lost_reason = reason
+                        report.lost.append((pid, reason))
+                        report.lost_at = now
+                        result.hosts_lost += 1
+                        self.registry.counter("cluster.host_lost",
+                                              reason=reason).inc()
+                        log_event("cluster", f"replica {pid} lost "
+                                  f"({reason}, rc={rc})", level="warning",
+                                  verbose=self.verbose,
+                                  logger=getattr(self.tracer,
+                                                 "_logger", None),
+                                  pid=pid, reason=reason, rc=rc)
+                        if self.tracer is not None:
+                            self.tracer.record_span(
+                                "host.lost", duration_s=0.0,
+                                parent=launch_span, status="error",
+                                pid=pid, reason=reason,
+                                generation=incarnation[pid])
+                        if rc is None:
+                            self._drain([w])  # hung, not dead: put it down
+                        pending_recovery[pid] = now
+                    if result.relaunches >= self.max_relaunches:
+                        raise HostLost(
+                            f"relaunch budget ({self.max_relaunches}) "
+                            f"exhausted (replica {pid}: "
+                            f"{reason or 'preempted'}); last stderr:\n"
+                            + self._tail(w.err_path))
+                    result.relaunches += 1
+                    self.registry.counter("cluster.relaunches").inc()
+                    incarnation[pid] += 1
+                    workers[pid] = self._spawn_worker(
+                        incarnation[pid], pid, self.nproc, port)
+                    log_event("cluster", f"replica {pid} respawned in "
+                              f"place (incarnation {incarnation[pid]})",
+                              verbose=self.verbose,
+                              logger=getattr(self.tracer, "_logger", None),
+                              pid=pid, incarnation=incarnation[pid],
+                              level="warning")
+                if all(w.proc.poll() == 0 for w in workers.values()):
+                    report.wall_s = time.monotonic() - t0
+                    report.returncodes = [workers[pid].proc.returncode
+                                          for pid in sorted(workers)]
+                    if self.tracer is not None:
+                        self.tracer.close_span(launch_span, status="ok")
+                        launch_span = None
+                    return result
+                if now > deadline:
+                    self._drain(list(workers.values()))
+                    raise HostLost(
+                        f"replica group timed out after {timeout_s:.0f}s "
+                        f"(rc={[w.proc.poll() for w in workers.values()]})")
+                time.sleep(self.poll_s)
+        except BaseException:
+            report.wall_s = time.monotonic() - t0
+            report.returncodes = [workers[pid].proc.poll()
+                                  for pid in sorted(workers)]
+            if self.tracer is not None and launch_span is not None:
+                self.tracer.close_span(launch_span, status="error")
+            raise
 
     # ------------------------------------------------------------------ #
     def _watch(self, workers, report: GenerationReport, deadline: float,

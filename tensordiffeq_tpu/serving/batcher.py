@@ -461,3 +461,47 @@ class RequestBatcher:
                                   if self._batch_walls else None),
             "latency_s": percentiles(self._latencies),
         }
+
+    def snapshot(self) -> dict:
+        """One CONSISTENT observation of this batcher: ``pending_points``
+        and the :meth:`stats` dict captured together.  :meth:`stats` reads
+        each counter attribute separately, so a flush on another thread
+        can land between reads and tear the derived ``requests`` number
+        (the router's old two-pass scrape could even report more pending
+        points than requests).  Here every field is copied into locals
+        first — each copy is atomic under the GIL — and the derived
+        values are computed from those copies only, so the result is
+        internally consistent even against a concurrent flush."""
+        pending = tuple(self._pending)
+        n_requests = self._n_requests
+        n_failed = self._n_failed
+        n_timed_out = self._n_timed_out
+        n_rejected = self._n_rejected
+        n_retried_ok = self._n_retried_ok
+        n_batches = self._n_batches
+        n_points = self._n_points
+        first = self._first_submit
+        last = self._last_flush
+        walls = tuple(self._batch_walls)
+        lats = tuple(self._latencies)
+        span = None
+        if last is not None and first is not None:
+            span = last - first
+        served = max(0, n_requests - len(pending) - n_failed
+                     - n_timed_out - n_rejected)
+        return {
+            "pending_points": sum(x.shape[0] for x, _, _ in pending),
+            "stats": {
+                "requests": served,
+                "failed": n_failed,
+                "timed_out": n_timed_out,
+                "rejected": n_rejected,
+                "retried_ok": n_retried_ok,
+                "batches": n_batches,
+                "points": n_points,
+                "qps": None if not span else served / span,
+                "batch_wall_mean_s": (float(np.mean(walls))
+                                      if walls else None),
+                "latency_s": percentiles(lats),
+            },
+        }

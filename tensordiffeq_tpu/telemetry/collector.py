@@ -52,6 +52,13 @@ from .registry import MetricsRegistry, _key
 from .runlog import EVENTS_FILE, MANIFEST_FILE, event_segments
 from .slo import SLOSet, _parse_key, to_prometheus
 
+#: Live registry snapshot a still-running process drops in its run dir
+#: (``{"metrics": registry.as_dict()}``, written atomically via a tmp
+#: file + ``os.replace``) so a collector can scrape it REMOTELY before
+#: the RunLogger finalizes — a serving replica beats this out alongside
+#: its heartbeat.  The manifest's closing snapshot wins once it exists.
+SNAPSHOT_FILE = "metrics.live.json"
+
 
 class _Tail:
     """Resumable multi-segment tail of one run dir's event files.
@@ -210,10 +217,23 @@ class Collector:
 
     @staticmethod
     def _read_manifest_metrics(run_dir: str) -> Optional[dict]:
-        """A run's closing metrics snapshot (present once its RunLogger
-        finalized; None while it is still live or after a kill)."""
+        """A run's metrics snapshot: the manifest's closing one once its
+        RunLogger finalized, else a live :data:`SNAPSHOT_FILE` the
+        still-running process published (a serving replica writes one per
+        heartbeat).  None when neither exists (a killed run's tail).
+        The manifest probe must fall THROUGH on a manifest without
+        metrics — RunLogger writes an initial manifest at open and only
+        adds the snapshot at close, so for a run's whole lifetime the
+        manifest exists metric-less while the live file is the truth."""
         try:
             with open(os.path.join(str(run_dir), MANIFEST_FILE)) as fh:
+                snap = json.load(fh).get("metrics")
+            if snap:
+                return snap
+        except (OSError, json.JSONDecodeError):
+            pass
+        try:
+            with open(os.path.join(str(run_dir), SNAPSHOT_FILE)) as fh:
                 return json.load(fh).get("metrics") or None
         except (OSError, json.JSONDecodeError):
             return None

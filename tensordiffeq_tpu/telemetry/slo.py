@@ -79,6 +79,17 @@ def _max_gauge(metrics: dict, base: str) -> Optional[float]:
     return worst
 
 
+def _min_gauge(metrics: dict, base: str) -> Optional[float]:
+    """Worst value across every labeled instance of a HIGHER-IS-BETTER
+    gauge ``base`` (availability-style: the minimum is the worst)."""
+    worst = None
+    for key, v in (metrics.get("gauges") or {}).items():
+        if _parse_key(key)[0] != base or not isinstance(v, (int, float)):
+            continue
+        worst = v if worst is None else min(worst, v)
+    return worst
+
+
 def _objective(value, threshold) -> dict:
     """One objective's verdict row.  ``ok`` is None when there is no
     data — absence of traffic is not a breach."""
@@ -111,6 +122,14 @@ class SLOSet:
         may degrade to 3x its export-time residual before the retrain
         loop owes a response").  Like every objective, no monitored
         traffic means no verdict (``ok=None``), not a breach.
+      min_replica_availability: the one HIGHER-IS-BETTER objective —
+        worst acceptable fraction of a replica group's front-tier
+        endpoints that are reachable (``fleet.replica.availability``
+        gauges, written by
+        :class:`~tensordiffeq_tpu.fleet.FrontRouter`; 0.99 = "at most
+        1% of replica capacity may be breaker-open").  Its burn rate is
+        the UNAVAILABLE fraction over the unavailability budget, so >1
+        still means "error budget burning" like every other objective.
       window: events per window for the step-regression comparison.
     """
 
@@ -119,17 +138,22 @@ class SLOSet:
                  max_timeout_fraction: float = 0.01,
                  max_step_regression: float = 1.5,
                  max_residual_drift: float = 3.0,
+                 min_replica_availability: float = 0.99,
                  window: int = 20):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if max_residual_drift <= 0:
             raise ValueError("max_residual_drift must be > 0, got "
                              f"{max_residual_drift}")
+        if not 0.0 < float(min_replica_availability) <= 1.0:
+            raise ValueError("min_replica_availability must be in (0, 1], "
+                             f"got {min_replica_availability}")
         self.serving_p99_s = float(serving_p99_s)
         self.max_rejected_fraction = float(max_rejected_fraction)
         self.max_timeout_fraction = float(max_timeout_fraction)
         self.max_step_regression = float(max_step_regression)
         self.max_residual_drift = float(max_residual_drift)
+        self.min_replica_availability = float(min_replica_availability)
         self.window = int(window)
 
     @classmethod
@@ -173,6 +197,18 @@ class SLOSet:
             "residual_drift": _objective(
                 _max_gauge(metrics, "fleet.drift.level"),
                 self.max_residual_drift),
+        }
+        # replica availability (PR 20) is higher-is-better, so _objective's
+        # value<=threshold comparison is inverted here: ok when the WORST
+        # group's availability still clears the floor, burn rate = observed
+        # unavailable fraction over the unavailability budget
+        avail = _min_gauge(metrics, "fleet.replica.availability")
+        floor = self.min_replica_availability
+        objectives["replica_availability"] = {
+            "value": avail, "threshold": floor,
+            "ok": None if avail is None else bool(avail >= floor),
+            "burn_rate": None if avail is None else round(
+                (1.0 - avail) / max(1.0 - floor, 1e-9), 4),
         }
         breaches = sorted(k for k, o in objectives.items()
                           if o["ok"] is False)

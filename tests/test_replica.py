@@ -1,0 +1,574 @@
+"""Replicated serving plane (tensordiffeq_tpu.fleet.replica): the
+fleet-of-fleets front tier and the contracts the ISSUE pins — chaos-off
+replicated serving answers bit-identical to a direct FleetRouter,
+rendezvous hashing only re-homes the lost replica's tenants, tenant
+breakers relay through the front without burning replica breakers, and
+the E2E drill: a 2-replica group under live mixed u/residual traffic
+loses a replica to ``host_loss_at`` and EVERY query is still answered
+(zero lost, zero request-time compiles on the survivor) while the
+serving-mode supervisor respawns the slot warm and the stitched trace +
+scraped /metrics prove the incident.
+
+All CPU, all tier-1 fast.  The real replica group is started by a
+module fixture as early as possible and only JOINED by the last test,
+so the workers' jax imports and artifact warm starts overlap the
+in-process tests instead of stacking onto the suite wall-clock."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensordiffeq_tpu import fleet
+from tensordiffeq_tpu.fleet import (AdmissionController, FleetRouter,
+                                    FrontRouter, ReplicaGroup,
+                                    ReplicaRequestError, ReplicaServer,
+                                    decode_array, encode_array)
+from tensordiffeq_tpu.fleet.replica import (_decode_result, _encode_result,
+                                            _rendezvous_weight)
+from tensordiffeq_tpu.resilience import Chaos, CircuitOpenError
+from tensordiffeq_tpu.telemetry import MetricsRegistry, RunLogger, SLOSet
+from tensordiffeq_tpu.telemetry import tracing
+from tensordiffeq_tpu.telemetry.collector import SNAPSHOT_FILE
+from tensordiffeq_tpu.telemetry.tracing import Tracer
+
+from test_fleet import (MAX_B, MIN_B, make_solver, query_points,
+                        small_policy)
+from test_slo import parse_exposition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# replica1 is tenant "a"'s rendezvous primary (asserted in the E2E), so
+# killing rank 1 mid-traffic forces a deterministic reroute of "a" while
+# "b" (primary replica0) must not notice
+CHAOS_SPEC = "host_loss_at=6,host_loss_rank=1"
+
+BOOTSTRAP = '''\
+"""Replica bootstrap for tests/test_replica.py (imported by each replica
+worker via --bootstrap; PYTHONPATH carries this dir + the repo)."""
+import numpy as np
+
+from tensordiffeq_tpu import grad
+from tensordiffeq_tpu.fleet import FleetRouter, TenantPolicy
+
+ART = {arts!r}
+
+
+def f_model(u, x, t):
+    u_x, u_t = grad(u, "x"), grad(u, "t")
+    return u_t(x, t) + u(x, t) * u_x(x, t) - 0.01 * grad(u_x, "x")(x, t)
+
+
+def make_router():
+    router = FleetRouter(max_loaded=4)
+    for name, art in sorted(ART.items()):
+        router.register(
+            name, art,
+            policy=TenantPolicy(min_bucket={min_b}, max_bucket={max_b},
+                                max_batch=256, max_latency_s=0.005),
+            f_model=f_model)
+    return router
+'''
+
+
+# --------------------------------------------------------------------------- #
+# fixtures
+# --------------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Two AOT fleet artifacts (same Burgers family as test_fleet, its
+    exact f_model) shared by the in-process routers AND the replica
+    workers."""
+    root = tmp_path_factory.mktemp("replica_artifacts")
+    out = {}
+    for name, seed in (("a", 0), ("b", 1)):
+        s, f_model = make_solver(seed=seed)
+        art = str(root / name)
+        fleet.export_fleet_artifact(
+            s.export_surrogate(), art, min_bucket=MIN_B, max_bucket=MAX_B)
+        out[name] = art
+        out["f_model"] = f_model
+    return out
+
+
+@pytest.fixture(scope="module")
+def group(artifacts, tmp_path_factory):
+    """The REAL 2-replica group: separate worker processes under a
+    serving-mode ClusterSupervisor, armed with the host-loss chaos spec.
+    Started here — as early in the module as the artifacts allow — and
+    only awaited by the E2E test at the end of the file, so worker boot
+    (jax import + warm start) runs concurrently with every in-process
+    test between."""
+    root = tmp_path_factory.mktemp("replica_group")
+    boot_dir = root / "boot"
+    boot_dir.mkdir()
+    (boot_dir / "tdq_replica_boot.py").write_text(BOOTSTRAP.format(
+        arts={"a": artifacts["a"], "b": artifacts["b"]},
+        min_b=MIN_B, max_b=MAX_B))
+    front_dir = str(root / "front_run")
+    logger = RunLogger(front_dir, config={"role": "front"})
+    sup_tracer = Tracer(logger=logger)
+    g = ReplicaGroup(
+        "tdq_replica_boot:make_router", nproc=2,
+        workdir=str(root / "replicas"),
+        heartbeat_timeout_s=180.0, max_relaunches=2,
+        env={"PYTHONPATH": f"{boot_dir}{os.pathsep}{REPO}",
+             "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "TDQ_CHAOS": CHAOS_SPEC},
+        tracer=sup_tracer, registry=MetricsRegistry())
+    g.start(timeout_s=600.0)
+    coll = g.serve_metrics(host="rep-host")
+    yield {"group": g, "tracer": sup_tracer, "logger": logger,
+           "front_dir": front_dir, "collector": coll}
+    try:
+        coll.close()
+    finally:
+        try:
+            g.shutdown(timeout_s=120.0)  # no-op if the E2E already did
+        finally:
+            logger.close()
+
+
+def test_group_launches(group):
+    """First test in the file: touching the fixture starts the worker
+    boot NOW; assert only what is synchronously true."""
+    eps = group["group"].endpoints()
+    assert sorted(eps) == ["replica0", "replica1"]
+    assert all(u.startswith("http://127.0.0.1:") for u in eps.values())
+    assert len(group["group"].run_dirs()) == 6  # 2 slots x 3 incarnations
+
+
+# --------------------------------------------------------------------------- #
+# wire codec
+# --------------------------------------------------------------------------- #
+def test_array_codec_bit_exact_roundtrip():
+    """The HTTP payload codec must be byte-identical both ways — it is
+    what makes 'replicated serve == direct router' a bit-level claim."""
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.random.RandomState(0).randn(7, 2).astype(np.float64),
+                np.array([[1, -2], [3, 4]], dtype=np.int32),
+                np.float32([[np.pi]])):
+        back = decode_array(encode_array(arr))
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        assert back.tobytes() == arr.tobytes()
+    # tuple results (e.g. value+aux kinds) survive the result wrapper
+    t = (np.float32([[1.5]]), np.arange(3, dtype=np.float64))
+    back = _decode_result(_encode_result(t))
+    assert isinstance(back, tuple) and len(back) == 2
+    for a, b in zip(t, back):
+        assert b.tobytes() == a.tobytes() and b.dtype == a.dtype
+
+
+def test_rendezvous_remap_bound():
+    """Removing one replica re-homes ONLY the tenants whose top weight
+    it held, each onto its previous second choice — every other
+    tenant's candidate order is untouched (the consistent-hashing remap
+    bound, with no ring state)."""
+    urls = {f"r{i}": f"http://127.0.0.1:{40000 + i}" for i in range(5)}
+    front5 = FrontRouter(urls, registry=MetricsRegistry())
+    tenants = [f"tenant{i}" for i in range(200)]
+    before = {t: front5.candidates(t) for t in tenants}
+    # sanity: the weight function actually spreads primaries around
+    primaries = {before[t][0] for t in tenants}
+    assert primaries == set(urls)
+    removed = "r2"
+    front4 = FrontRouter({k: v for k, v in urls.items() if k != removed},
+                         registry=MetricsRegistry())
+    moved = 0
+    for t in tenants:
+        after = front4.candidates(t)
+        if before[t][0] == removed:
+            moved += 1
+            assert after[0] == before[t][1]  # old runner-up takes over
+        else:
+            assert after[0] == before[t][0]  # everyone else: untouched
+            assert after == [n for n in before[t] if n != removed]
+    assert 0 < moved < len(tenants)
+    # and the weights themselves are deterministic across processes
+    assert _rendezvous_weight("a", "replica0") \
+        == _rendezvous_weight("a", "replica0")
+
+
+# --------------------------------------------------------------------------- #
+# chaos-off bit identity + tenant-breaker relay (in-process replica)
+# --------------------------------------------------------------------------- #
+def test_replicated_serve_bit_identical_to_direct_router(artifacts):
+    """Chaos off: FrontRouter -> HTTP -> ReplicaServer -> FleetRouter
+    answers BIT-identical to a direct FleetRouter over the same
+    artifacts, for both kinds."""
+    def build():
+        r = FleetRouter(max_loaded=2, registry=MetricsRegistry())
+        for t in ("a", "b"):
+            r.register(t, artifacts[t], f_model=artifacts["f_model"],
+                       policy=small_policy())
+        return r
+
+    direct = build()
+    srv = ReplicaServer(build(), rank=0, registry=MetricsRegistry())
+    try:
+        url = srv.serve()
+        front = FrontRouter({"replica0": url}, registry=MetricsRegistry())
+        for i, (tenant, kind) in enumerate(
+                [("a", "u"), ("b", "u"), ("a", "residual"),
+                 ("b", "residual"), ("a", "u")]):
+            X = query_points(8, seed=10 + i)
+            got = np.asarray(front.query(tenant, X, kind=kind))
+            want = np.asarray(direct.query(tenant, X, kind=kind))
+            assert got.dtype == want.dtype and got.shape == want.shape
+            assert got.tobytes() == want.tobytes(), (tenant, kind)
+        # the replica tallied them and its health endpoint agrees
+        ready = srv.readiness()
+        assert ready["ready"] and ready["requests"] == 5
+        front.close()
+    finally:
+        srv.close()
+
+
+def test_tenant_breaker_relays_without_burning_replica_breaker(artifacts):
+    """A tenant-scoped failure inside a replica must come back as the
+    SAME structured error a direct router raises — and must count as a
+    breaker SUCCESS at the front (the replica answered; it is not
+    dead).  Tenant b keeps serving through the same replica
+    throughout."""
+    router = FleetRouter(max_loaded=2, registry=MetricsRegistry())
+    pol = small_policy(breaker_failure_threshold=1,
+                       breaker_reset_timeout_s=3600.0)
+    for t in ("a", "b"):
+        router.register(t, artifacts[t], f_model=artifacts["f_model"],
+                        policy=pol)
+    srv = ReplicaServer(router, rank=0, registry=MetricsRegistry())
+    try:
+        url = srv.serve()
+        front = FrontRouter({"replica0": url}, registry=MetricsRegistry())
+        with Chaos(serving_fail_n=1):
+            with pytest.raises(ReplicaRequestError) as ei:
+                front.query("a", query_points(4))  # injected engine fault
+        assert ei.value.status == 500
+        # tenant a's breaker (inside the replica) is now open: the relay
+        # is the native CircuitOpenError, not a transport failure
+        with pytest.raises(CircuitOpenError):
+            front.query("a", query_points(4))
+        # the replica breaker at the front NEVER opened on any of that
+        assert front.autoscale_signals()["replicas"]["replica0"] == "closed"
+        assert front.availability() == 1.0
+        # isolation: tenant b serves through the same replica
+        assert np.asarray(front.query("b", query_points(4))).shape == (4, 1)
+        # unknown tenants relay as KeyError off a healthy replica too
+        with pytest.raises(KeyError):
+            front.query("nobody", query_points(2))
+        assert front.autoscale_signals()["replicas"]["replica0"] == "closed"
+        front.close()
+    finally:
+        srv.close()
+
+
+def test_hedged_query_fires_on_slow_primary(artifacts):
+    """hedge_after_s: a primary that accepted the connection but never
+    answers must not hold the caller — the hedge starts on the rotated
+    candidate list and the first success wins."""
+    router = FleetRouter(max_loaded=2, registry=MetricsRegistry())
+    srv = ReplicaServer(router, rank=0, registry=MetricsRegistry())
+    tarpit = socket.socket()
+    try:
+        url = srv.serve()
+        tarpit.bind(("127.0.0.1", 0))
+        tarpit.listen(1)  # connections land in the backlog, never served
+        slow_url = "http://127.0.0.1:%d" % tarpit.getsockname()[1]
+        reg = MetricsRegistry()
+        front = FrontRouter({"slow": slow_url, "fast": url},
+                            registry=reg, hedge_after_s=0.15,
+                            call_timeout_s=2.0, deadline_s=10.0)
+        # pick a tenant whose rendezvous PRIMARY is the tarpit, then
+        # serve it from the real replica
+        tenant = next(t for t in (f"h{i}" for i in range(64))
+                      if front.candidates(t)[0] == "slow")
+        router.register(tenant, artifacts["a"],
+                        f_model=artifacts["f_model"],
+                        policy=small_policy())
+        t0 = time.monotonic()
+        out = front.query(tenant, query_points(4))
+        waited = time.monotonic() - t0
+        assert np.asarray(out).shape == (4, 1)
+        assert waited < 2.0  # did not sit out the primary's socket timeout
+        hedges = [v for k, v in reg.as_dict()["counters"].items()
+                  if k.startswith("fleet.failover.hedges")]
+        assert sum(hedges) == 1
+        front.close()
+    finally:
+        tarpit.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------------- #
+# atomic scrape snapshots (satellite: stats()/autoscale_signals() torn reads)
+# --------------------------------------------------------------------------- #
+def test_scrape_snapshots_consistent_under_concurrent_flush(artifacts):
+    """stats() and autoscale_signals() are built from one consistent
+    snapshot per tenant: while a hammer thread serves queries (flushes
+    mutating every counter), a concurrent scraper must never observe a
+    torn pair — the fleet pending_points total must ALWAYS equal the sum
+    of the per-tenant queue depths captured in the same call, and no
+    derived batcher stat may go negative."""
+    router = FleetRouter(max_loaded=2, registry=MetricsRegistry())
+    router.register("a", artifacts["a"], f_model=artifacts["f_model"],
+                    policy=small_policy())
+    router.load("a")
+    stop = threading.Event()
+    errs = []
+
+    def hammer():
+        i = 0
+        try:
+            while not stop.is_set():
+                router.query("a", query_points(4, seed=i % 17))
+                i += 1
+        except Exception as e:  # surfaced below; a daemon must not hide it
+            errs.append(e)
+
+    th = threading.Thread(target=hammer, daemon=True)
+    th.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        scrapes = 0
+        while time.monotonic() < deadline:
+            sig = router.autoscale_signals()
+            assert sig["pending_points"] == sum(
+                t["queue_depth"] for t in sig["tenants"].values()), \
+                "torn scrape: fleet total != sum of per-tenant depths"
+            snap = router.stats()["tenants"]["a"]
+            if snap["loaded"]:
+                for kind, s in snap["kinds"].items():
+                    assert s["requests"] >= 0, (kind, s)
+                    assert s["batches"] >= 0 and s["points"] >= 0
+            scrapes += 1
+    finally:
+        stop.set()
+        th.join(timeout=10.0)
+    assert not errs, errs
+    assert scrapes > 50  # the scraper really ran against live traffic
+
+
+# --------------------------------------------------------------------------- #
+# availability SLO + quorum degradation units
+# --------------------------------------------------------------------------- #
+def test_replica_availability_slo_objective():
+    """The one higher-is-better objective: ok when the worst
+    availability gauge clears the floor; burn rate = unavailable
+    fraction over the unavailability budget (>1 still means 'budget
+    burning')."""
+    reg = MetricsRegistry()
+    slos = SLOSet(min_replica_availability=0.75)
+    verdict = slos.evaluate(reg)
+    assert verdict["objectives"]["replica_availability"]["ok"] is None
+    reg.gauge("fleet.replica.availability").set(0.5)
+    verdict = slos.evaluate(reg)
+    obj = verdict["objectives"]["replica_availability"]
+    assert obj["ok"] is False
+    assert "replica_availability" in verdict["breaches"]
+    assert obj["burn_rate"] == pytest.approx(2.0)  # (1-.5)/(1-.75)
+    reg.gauge("fleet.replica.availability").set(1.0)
+    obj = slos.evaluate(reg)["objectives"]["replica_availability"]
+    assert obj["ok"] is True and obj["burn_rate"] == 0.0
+    with pytest.raises(ValueError):
+        SLOSet(min_replica_availability=0.0)
+
+
+def test_quorum_loss_degrades_admission_and_restores():
+    """Below quorum the front tightens the admission watermarks
+    (graceful degradation: fewer replicas -> accept less, shed early);
+    back at quorum the nominal watermarks return exactly."""
+    class Clk:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clk()
+    reg = MetricsRegistry()
+    adm = AdmissionController(max_pending_points=1024, registry=reg)
+    front = FrontRouter({"r0": "http://127.0.0.1:1",
+                         "r1": "http://127.0.0.1:2"},
+                        admission=adm, registry=reg, clock=clk,
+                        breaker_failure_threshold=1,
+                        breaker_reset_timeout_s=5.0)
+    assert front.quorum == 2  # majority of 2
+    nominal = adm.max_pending_points
+    sig = front.autoscale_signals()
+    assert not sig["below_quorum"] and not sig["degraded"]
+
+    front._breakers["r0"].record_failure()  # transport loss -> open
+    front._update_availability()
+    sig = front.autoscale_signals()
+    assert sig["replicas"]["r0"] == "open"
+    assert sig["availability"] == 0.5
+    assert sig["below_quorum"] and sig["degraded"]
+    assert adm.max_pending_points < nominal
+    assert reg.gauge("fleet.admission.degraded").value == 1
+    assert reg.gauge("fleet.replica.availability").value == 0.5
+    # degrade is idempotent against repeated availability updates
+    front._update_availability()
+    tightened = adm.max_pending_points
+    front._update_availability()
+    assert adm.max_pending_points == tightened
+
+    clk.t += 10.0  # cool-down elapses; the probe succeeds
+    assert front._breakers["r0"].allow()
+    front._breakers["r0"].record_success()
+    front._update_availability()
+    sig = front.autoscale_signals()
+    assert not sig["below_quorum"] and not sig["degraded"]
+    assert adm.max_pending_points == nominal  # exact restore
+    assert reg.gauge("fleet.admission.degraded").value == 0
+    assert reg.gauge("fleet.replica.availability").value == 1.0
+
+
+# --------------------------------------------------------------------------- #
+# the E2E drill (must stay LAST in this file: it joins the module group)
+# --------------------------------------------------------------------------- #
+def _live_compiles(run_dir, timeout_s=30.0):
+    """Request-time compile tally from the replica's live metrics
+    snapshot.  The beat thread publishes one atomically every beat, but
+    /healthz can answer before the FIRST beat lands — so wait for the
+    file rather than racing it."""
+    path = os.path.join(run_dir, SNAPSHOT_FILE)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with open(path) as fh:
+                snap = json.load(fh)
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+    return sum(v for k, v in snap["metrics"]["counters"].items()
+               if k.startswith("serving.engine.compiles"))
+
+
+def test_e2e_replica_host_loss_failover(artifacts, group):
+    """The acceptance drill: 2 real replica processes, live mixed
+    u/residual traffic for two tenants, chaos hard-kills tenant a's
+    primary replica mid-traffic.  Every query must still be answered
+    bit-identical to an in-process reference router (zero lost, zero
+    request-time compiles on the survivor), the supervisor must respawn
+    the slot warm, the breaker must re-admit it after the cool-down,
+    and the incident must be provable from the outside: one stitched
+    Perfetto timeline and one scraped /metrics exposition."""
+    g = group["group"]
+    ready = g.wait_ready(timeout_s=420.0)
+    assert sorted(ready) == ["replica0", "replica1"]
+    for body in ready.values():
+        assert sorted(body["tenants"]) == ["a", "b"]  # warm BEFORE ready
+
+    survivor_dir = os.path.join(g.workdir, "replica0.gen0")
+    base_compiles = _live_compiles(survivor_dir)
+
+    # in-process reference: same artifacts, same f_model, no chaos
+    ref = FleetRouter(max_loaded=2, registry=MetricsRegistry())
+    for t in ("a", "b"):
+        ref.register(t, artifacts[t], f_model=artifacts["f_model"],
+                     policy=small_policy())
+
+    front_reg = MetricsRegistry()
+    front = FrontRouter(g.endpoints(), deadline_s=30.0,
+                        breaker_reset_timeout_s=1.0, registry=front_reg)
+    # the chaos victim (rank 1) is tenant a's rendezvous primary — the
+    # reroute below is deterministic, not luck
+    assert front.candidates("a")[0] == "replica1"
+    assert front.candidates("b")[0] == "replica0"
+
+    # the front joins the SUPERVISOR's trace so the whole incident —
+    # front request spans, breaker-open/reroute events, host.lost,
+    # host.join — stitches into one timeline
+    front_tracer = Tracer(logger=group["logger"],
+                          context=group["tracer"].context())
+    avail_min, answered = 1.0, 0
+    with front_tracer:
+        for i in range(24):
+            tenant = "ab"[i % 2]
+            kind = "u" if i % 3 else "residual"
+            X = query_points(8, seed=100 + i)
+            got = np.asarray(front.query(tenant, X, kind=kind))
+            want = np.asarray(ref.query(tenant, X, kind=kind))
+            assert got.tobytes() == want.tobytes(), (i, tenant, kind)
+            answered += 1
+            avail_min = min(avail_min, front.availability())
+    assert answered == 24  # zero lost queries through the host loss
+
+    counters = front_reg.as_dict()["counters"]
+
+    def csum(prefix):
+        return sum(v for k, v in counters.items() if k.startswith(prefix))
+
+    assert csum("fleet.failover.attempts") >= 1  # the dropped connection
+    assert csum("fleet.failover.reroutes") >= 1  # a re-homed onto replica0
+    assert csum("fleet.failover.unavailable") == 0
+    assert csum("fleet.front.requests") == 24
+    assert avail_min == 0.5  # the breaker DID open mid-incident
+    # the survivor absorbed the rerouted tenant without a single
+    # request-time compile (AOT warm start covers both tenants)
+    assert _live_compiles(survivor_dir) - base_compiles == 0
+
+    # recovery: the respawned slot comes back warm at the SAME endpoint
+    # and the half-open probe re-admits it after the cool-down
+    g.wait_ready(timeout_s=300.0)
+    time.sleep(1.1)  # past breaker_reset_timeout_s
+    front.query("a", query_points(8, seed=999))
+    sig = front.autoscale_signals()
+    assert sig["replicas"]["replica1"] == "closed"
+    assert sig["availability"] == 1.0 and not sig["below_quorum"]
+
+    # ---- one fleet-wide scrape: supervisor + live replica snapshots +
+    # the front's own instruments, all under host/process labels ----
+    coll = group["collector"]
+    coll.attach_registry(front_reg, host="rep-host", process="front")
+    time.sleep(0.7)  # one beat interval: let live snapshots catch up
+    body = urllib.request.urlopen(f"{coll.url}/metrics",
+                                  timeout=10).read().decode()
+    samples, types = parse_exposition(body)
+
+    def sample(name, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        assert key in samples, (name, labels, sorted(samples)[:40])
+        return samples[key]
+
+    sup_proc = f"supervisor:{os.getpid()}"
+    assert sample("cluster_host_lost_total", host="rep-host",
+                  process=sup_proc, reason="exit") == 1
+    assert sample("cluster_relaunches_total", host="rep-host",
+                  process=sup_proc) == 1
+    assert sample("fleet_failover_reroutes_total", host="rep-host",
+                  process="front") >= 1
+    assert types["fleet_replica_availability"] == "gauge"
+    assert sample("fleet_replica_availability", host="rep-host",
+                  process="front") == 1.0
+    replica_reqs = sum(v for (name, _), v in samples.items()
+                       if name == "fleet_replica_requests_total")
+    assert replica_reqs >= 10  # live replica snapshots made it through
+
+    # ---- goodbye: drain-then-exit, zero dropped waiters ----
+    result = g.shutdown(timeout_s=180.0)
+    assert result is not None and result.ok, result
+    assert result.hosts_lost == 1 and result.relaunches == 1
+    assert len(result.recovery_wall_s) == 1 and result.recovery_wall_s[0] > 0
+
+    # ---- the stitched trace renders the incident as ONE timeline ----
+    all_dirs = [group["front_dir"]] + [d for d in g.run_dirs()
+                                       if os.path.isdir(d)]
+    assert len(all_dirs) == 4  # front + r0.gen0 + r1.gen0 + r1.gen1
+    stitched = tracing.to_perfetto(all_dirs)
+    slices = [ev for ev in stitched["traceEvents"] if ev["ph"] == "X"]
+    names = {ev["name"] for ev in slices}
+    assert "cluster.launch" in names and "host.lost" in names
+    assert "host.join" in names          # incl. the respawned slot
+    assert "fleet.front.request" in names
+    assert "fleet.front.reroute" in names or \
+        "fleet.front.breaker_open" in names
+    assert "fleet.request" in names      # worker-side span, same trace
+    assert len({ev["args"]["trace_id"] for ev in slices}) == 1, \
+        "failover incident did not stitch into a single trace"
